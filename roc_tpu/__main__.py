@@ -1,0 +1,69 @@
+"""CLI entry: ``python -m roc_tpu -dataset cora -layers 1433-16-7 -e 200 ...``
+
+Mirrors the reference binary's invocation shape (test.sh:8):
+    ./gnn -ll:gpu 1 ... -lr 0.01 -decay 0.0001 -dropout 0.5 \
+          -layers 602-256-41 -file dataset/reddit-dgl -e 3000
+Here `-file <prefix>` consumes the same on-disk dataset format; `-dataset
+<name>` generates a deterministic synthetic stand-in (no-network builds).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gcn
+from roc_tpu.train.config import parse_args
+from roc_tpu.train.driver import Trainer
+
+
+def main(argv=None) -> int:
+    cfg = parse_args(sys.argv[1:] if argv is None else argv)
+    if not cfg.layers:
+        print("error: -layers is required (e.g. -layers 1433-16-7)",
+              file=sys.stderr)
+        return 2
+    # Config banner, mirroring gnn.cc:48-60.
+    print("        ===== GNN settings =====", file=sys.stderr)
+    print(f"        dataset = {cfg.filename or cfg.dataset} seed = {cfg.seed}\n"
+          f"        num_epochs = {cfg.num_epochs} learning_rate = {cfg.learning_rate:.4f}\n"
+          f"        weight_decay = {cfg.weight_decay:.4f} dropout_rate = {cfg.dropout_rate:.4f}\n"
+          f"        decay_rate = {cfg.decay_rate:.4f} decay_steps = {cfg.decay_steps}",
+          file=sys.stderr)
+    print(f"        Layers: {' '.join(map(str, cfg.layers))}", file=sys.stderr)
+
+    if cfg.filename:
+        ds = datasets.load_roc_dataset(cfg.filename, cfg.layers[0],
+                                       cfg.layers[-1])
+    elif cfg.dataset:
+        ds = datasets.get(cfg.dataset, seed=cfg.seed)
+        assert ds.in_dim == cfg.layers[0], (
+            f"-layers head {cfg.layers[0]} != dataset in_dim {ds.in_dim}")
+        assert ds.num_classes == cfg.layers[-1], (
+            f"-layers tail {cfg.layers[-1]} != dataset classes {ds.num_classes}")
+    else:
+        print("error: one of -file or -dataset is required", file=sys.stderr)
+        return 2
+
+    if cfg.model != "gcn":
+        print(f"error: model {cfg.model!r} arrives with the model zoo; "
+              "only gcn is wired into the CLI so far", file=sys.stderr)
+        return 2
+    model = build_gcn(cfg.layers, cfg.dropout_rate, cfg.aggr)
+
+    if cfg.num_parts > 1:
+        try:
+            from roc_tpu.parallel.spmd import SpmdTrainer
+        except ImportError:
+            print("error: the multi-shard (-parts > 1) trainer is not built "
+                  "yet; run single-shard for now", file=sys.stderr)
+            return 2
+        trainer = SpmdTrainer(cfg, ds, model)
+    else:
+        trainer = Trainer(cfg, ds, model)
+    trainer.train()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

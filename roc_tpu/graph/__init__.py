@@ -1,0 +1,5 @@
+from roc_tpu.graph.csr import Csr
+from roc_tpu.graph.partition import Partition, partition_graph
+from roc_tpu.graph import lux, datasets
+
+__all__ = ["Csr", "Partition", "partition_graph", "lux", "datasets"]

@@ -1,0 +1,110 @@
+"""Host-side CSR graph core (NumPy).
+
+The reference stores the graph as an in-edge CSR: for each destination vertex
+``v`` the row range holds the *source* vertex ids of v's in-edges
+(reference: load_task.cu:271-294 builds ``EdgeStruct{src,dst}`` with
+``dst = row vertex``; gnn.cc:790-793 creates rowPtr over vertices and colIdx
+over edges).  We keep the same orientation: ``col_idx[row_ptr[v]:row_ptr[v+1]]``
+are the sources of v's in-edges.
+
+Differences from the reference, by design:
+  * row_ptr is the standard exclusive-prefix form of length N+1 (the `.lux`
+    on-disk form — inclusive end offsets of length N — is converted at the IO
+    boundary, see roc_tpu/graph/lux.py).
+  * Everything here is plain NumPy on the host; device-side representations
+    (padded shards) are produced by roc_tpu/graph/partition.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Reference typedefs (types.h:5-7): V_ID=uint32, E_ID=uint64.  We use int32 /
+# int64 because XLA gathers want signed indices; the on-disk format keeps the
+# unsigned types.
+V_DTYPE = np.int32
+E_DTYPE = np.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class Csr:
+    """In-edge CSR: col_idx[row_ptr[v]:row_ptr[v+1]] = sources of v's in-edges."""
+
+    num_nodes: int
+    num_edges: int
+    row_ptr: np.ndarray  # [N+1] E_DTYPE, exclusive prefix, row_ptr[0]==0
+    col_idx: np.ndarray  # [E]   V_DTYPE, source vertex per edge
+
+    def __post_init__(self):
+        assert self.row_ptr.shape == (self.num_nodes + 1,)
+        assert self.col_idx.shape == (self.num_edges,)
+        assert self.row_ptr[0] == 0
+        assert self.row_ptr[-1] == self.num_edges
+
+    def validate(self) -> None:
+        # Mirrors the reference's load-time asserts (gnn.cc:797-800): row
+        # offsets monotone, final offset == numEdges, sources in range.
+        assert np.all(np.diff(self.row_ptr) >= 0), "row_ptr not monotone"
+        if self.num_edges:
+            assert self.col_idx.min() >= 0
+            assert self.col_idx.max() < self.num_nodes
+
+    @property
+    def in_degrees(self) -> np.ndarray:
+        """Per-vertex in-degree (the quantity InDegreeNorm divides by,
+        graphnorm_kernel.cu:19-57 computes it from row_ptr diffs)."""
+        return np.diff(self.row_ptr).astype(E_DTYPE)
+
+    @property
+    def dst_idx(self) -> np.ndarray:
+        """Per-edge destination vertex (expanded from row_ptr), sorted ascending."""
+        return np.repeat(
+            np.arange(self.num_nodes, dtype=V_DTYPE), np.diff(self.row_ptr)
+        )
+
+    def transpose(self) -> "Csr":
+        """Out-edge view as a CSR over sources (used by aggregation backward:
+        the reference reuses the same kernel with roles swapped,
+        scattergather_kernel.cu:160-170)."""
+        order = np.argsort(self.col_idx, kind="stable")
+        new_col = self.dst_idx[order].astype(V_DTYPE)
+        counts = np.bincount(self.col_idx, minlength=self.num_nodes)
+        new_row = np.zeros(self.num_nodes + 1, dtype=E_DTYPE)
+        np.cumsum(counts, out=new_row[1:])
+        return Csr(self.num_nodes, self.num_edges, new_row, new_col)
+
+
+def from_edges(num_nodes: int, src: np.ndarray, dst: np.ndarray) -> Csr:
+    """Build an in-edge CSR from an edge list (dedup is the caller's job)."""
+    src = np.asarray(src, dtype=V_DTYPE)
+    dst = np.asarray(dst, dtype=V_DTYPE)
+    assert src.shape == dst.shape
+    order = np.argsort(dst, kind="stable")
+    col_idx = src[order]
+    counts = np.bincount(dst, minlength=num_nodes)
+    row_ptr = np.zeros(num_nodes + 1, dtype=E_DTYPE)
+    np.cumsum(counts, out=row_ptr[1:])
+    return Csr(num_nodes, int(src.shape[0]), row_ptr, col_idx)
+
+
+def add_self_edges(g: Csr) -> Csr:
+    """Add one self-edge per vertex if not already present.
+
+    The reference consumes pre-processed ``<file>.add_self_edge.lux`` inputs
+    (gnn.cc:755); this is the converter that produces that graph from a raw
+    one.  Idempotent for graphs that already have all self-edges.
+    """
+    src = g.col_idx
+    dst = g.dst_idx
+    has_self = np.zeros(g.num_nodes, dtype=bool)
+    has_self[src[src == dst]] = True
+    missing = np.nonzero(~has_self)[0].astype(V_DTYPE)
+    if missing.size == 0:
+        return g
+    return from_edges(
+        g.num_nodes,
+        np.concatenate([src, missing]),
+        np.concatenate([dst, missing]),
+    )

@@ -1,0 +1,112 @@
+"""ROC on-disk format IO (`.lux`, `.feats.csv/.bin`, `.label`, `.mask`).
+
+Binary `.lux` layout (reference: gnn.cc:755-801 + load_task.cu:222-245):
+    uint32  numNodes
+    uint64  numEdges                      (FILE_HEADER_SIZE = 12, gnn.h:33)
+    uint64  raw_rows[numNodes]            inclusive END offsets per vertex
+                                          (raw_rows[N-1] == numEdges)
+    uint32  raw_cols[numEdges]            source vertex id per in-edge
+
+Sidecar files (load_task.cu:25-184):
+    <prefix>.feats.csv   one comma-separated float row per vertex
+    <prefix>.feats.bin   row-major float32 cache, written on first CSV parse
+    <prefix>.label       one int class id per vertex (whitespace separated)
+    <prefix>.mask        one of Train/Val/Test/None per line
+
+Mask encoding matches gnn.h:98-103: TRAIN=0, VAL=1, TEST=2, NONE=3.
+
+A fast native (C++) parse path is used when the roc_tpu native library is
+built (roc_tpu/native); this module is the authoritative pure-NumPy
+implementation and the correctness oracle for it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from roc_tpu.graph.csr import Csr, E_DTYPE, V_DTYPE
+
+MASK_TRAIN, MASK_VAL, MASK_TEST, MASK_NONE = 0, 1, 2, 3
+_MASK_NAMES = {"Train": MASK_TRAIN, "Val": MASK_VAL, "Test": MASK_TEST, "None": MASK_NONE}
+_MASK_STRS = {v: k for k, v in _MASK_NAMES.items()}
+
+LUX_SUFFIX = ".add_self_edge.lux"
+
+
+def read_lux(path: str) -> Csr:
+    """Read a `.lux` graph file into an exclusive-prefix CSR."""
+    with open(path, "rb") as f:
+        num_nodes = int(np.fromfile(f, dtype=np.uint32, count=1)[0])
+        num_edges = int(np.fromfile(f, dtype=np.uint64, count=1)[0])
+        raw_rows = np.fromfile(f, dtype=np.uint64, count=num_nodes)
+        assert raw_rows.shape[0] == num_nodes, "truncated .lux row section"
+        raw_cols = np.fromfile(f, dtype=np.uint32, count=num_edges)
+        assert raw_cols.shape[0] == num_edges, "truncated .lux col section"
+    # Reference asserts monotonicity and the final offset (gnn.cc:797-800).
+    assert np.all(np.diff(raw_rows.astype(np.int64)) >= 0)
+    assert num_nodes == 0 or raw_rows[-1] == num_edges
+    row_ptr = np.zeros(num_nodes + 1, dtype=E_DTYPE)
+    row_ptr[1:] = raw_rows.astype(E_DTYPE)
+    g = Csr(num_nodes, num_edges, row_ptr, raw_cols.astype(V_DTYPE))
+    g.validate()
+    return g
+
+
+def write_lux(path: str, g: Csr) -> None:
+    """Write a CSR in the reference's `.lux` layout (inclusive end offsets)."""
+    with open(path, "wb") as f:
+        np.asarray([g.num_nodes], dtype=np.uint32).tofile(f)
+        np.asarray([g.num_edges], dtype=np.uint64).tofile(f)
+        g.row_ptr[1:].astype(np.uint64).tofile(f)
+        g.col_idx.astype(np.uint32).tofile(f)
+
+
+def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
+    """Load node features, preferring the `.feats.bin` cache and writing it
+    after a CSV parse, exactly like the reference (load_task.cu:41-73)."""
+    bin_path = prefix + ".feats.bin"
+    if os.path.exists(bin_path):
+        feats = np.fromfile(bin_path, dtype=np.float32, count=num_nodes * in_dim)
+        assert feats.size == num_nodes * in_dim, "feats.bin size mismatch"
+        return feats.reshape(num_nodes, in_dim)
+    csv_path = prefix + ".feats.csv"
+    feats = np.loadtxt(csv_path, delimiter=",", dtype=np.float32, ndmin=2)
+    assert feats.shape == (num_nodes, in_dim), (
+        f"feats.csv shape {feats.shape} != ({num_nodes},{in_dim})")
+    feats.tofile(bin_path)
+    return feats
+
+
+def load_labels(prefix: str, num_nodes: int, num_classes: int) -> np.ndarray:
+    """Load int class ids and expand to one-hot float32 rows
+    (load_task.cu:110-123)."""
+    ids = np.loadtxt(prefix + ".label", dtype=np.int64).reshape(-1)
+    assert ids.shape[0] == num_nodes
+    assert ids.min() >= 0 and ids.max() < num_classes
+    onehot = np.zeros((num_nodes, num_classes), dtype=np.float32)
+    onehot[np.arange(num_nodes), ids] = 1.0
+    return onehot
+
+
+def load_mask(prefix: str, num_nodes: int) -> np.ndarray:
+    """Load the Train/Val/Test/None text mask (load_task.cu:160-180)."""
+    with open(prefix + ".mask") as f:
+        lines = [line.rstrip("\n") for line in f][:num_nodes]
+    assert len(lines) == num_nodes, "mask file too short"
+    try:
+        return np.asarray([_MASK_NAMES[ln] for ln in lines], dtype=np.int32)
+    except KeyError as e:
+        raise ValueError(f"Unrecognized mask: {e.args[0]!r}") from None
+
+
+def write_dataset(prefix: str, g: Csr, feats: np.ndarray, label_ids: np.ndarray,
+                  mask: np.ndarray) -> None:
+    """Write a full ROC-format dataset (graph + sidecars) under `prefix`."""
+    write_lux(prefix + LUX_SUFFIX, g)
+    np.savetxt(prefix + ".feats.csv", feats, delimiter=",", fmt="%.6g")
+    np.savetxt(prefix + ".label", label_ids.reshape(-1, 1), fmt="%d")
+    with open(prefix + ".mask", "w") as f:
+        for m in mask:
+            f.write(_MASK_STRS[int(m)] + "\n")

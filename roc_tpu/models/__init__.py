@@ -1,0 +1,4 @@
+from roc_tpu.models.model import GraphCtx, Model
+from roc_tpu.models.gcn import build_gcn
+
+__all__ = ["Model", "GraphCtx", "build_gcn"]

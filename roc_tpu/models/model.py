@@ -1,0 +1,168 @@
+"""Op-graph model builder (the reference's Model class, gnn.h:162-203).
+
+The reference builds a list of GnnOp objects via Model::dropout /
+::linear / ::indegree_norm / ::scatter_gather / ::relu / ::add /
+::softmax_cross_entropy (gnn.cc:75-92), then drives forward / backward /
+update over Legion index launches.  Here the same builder API produces a tiny
+op IR; `apply` folds it into one pure function, and backward is `jax.grad`
+of the masked-CE loss — there are no per-op backward tasks to write, and the
+reference's reset-vs-accumulate gradient bookkeeping (resetInputGrads,
+gnn.cc:702-716) is exactly what reverse-mode AD does automatically.
+
+Distribution boundary: ops are local to a vertex shard except aggregation,
+which needs remote rows.  `apply` therefore takes a :class:`GraphCtx` whose
+``aggregate(x)`` closure hides the data movement — dense segment-sum on one
+device, all_gather/halo-exchange + segment-sum inside `shard_map` (see
+roc_tpu/parallel) — so the same model IR runs single-chip or pod-wide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from roc_tpu import ops
+
+
+class GraphCtx(NamedTuple):
+    """Everything an op needs to know about the (shard of the) graph."""
+    aggregate: Callable[[jnp.ndarray, str], jnp.ndarray]  # x, aggr_type -> out
+    in_degree: jnp.ndarray  # [N_local] float32, >= 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorRef:
+    """Symbolic handle returned by builder methods (the reference's Tensor)."""
+    id: int
+    dim: int
+
+
+@dataclasses.dataclass(frozen=True)
+class OpNode:
+    kind: str                 # dropout|linear|norm|aggregate|activation|add
+    inputs: tuple             # input tensor ids
+    out: int                  # output tensor id
+    attrs: dict               # op-specific attributes
+
+
+class Model:
+    """Builder + applier for a GNN op graph over node tensors."""
+
+    def __init__(self, in_dim: int):
+        self._next_id = 1
+        self.input = TensorRef(0, in_dim)
+        self.ops: List[OpNode] = []
+        self.logits: Optional[TensorRef] = None
+        self.num_linear = 0
+        self.num_dropout = 0
+
+    # -- builder API (names mirror the reference's Model methods) ---------
+    def _new(self, dim: int) -> TensorRef:
+        t = TensorRef(self._next_id, dim)
+        self._next_id += 1
+        return t
+
+    def dropout(self, t: TensorRef, rate: float) -> TensorRef:
+        out = self._new(t.dim)
+        self.ops.append(OpNode("dropout", (t.id,), out.id,
+                               {"rate": rate, "slot": self.num_dropout}))
+        self.num_dropout += 1
+        return out
+
+    def linear(self, t: TensorRef, out_dim: int,
+               activation: str = "none") -> TensorRef:
+        out = self._new(out_dim)
+        self.ops.append(OpNode("linear", (t.id,), out.id,
+                               {"in_dim": t.dim, "out_dim": out_dim,
+                                "activation": activation,
+                                "param": f"linear_{self.num_linear}"}))
+        self.num_linear += 1
+        return out
+
+    def indegree_norm(self, t: TensorRef) -> TensorRef:
+        out = self._new(t.dim)
+        self.ops.append(OpNode("norm", (t.id,), out.id, {}))
+        return out
+
+    def scatter_gather(self, t: TensorRef, aggr: str = "sum") -> TensorRef:
+        out = self._new(t.dim)
+        self.ops.append(OpNode("aggregate", (t.id,), out.id, {"aggr": aggr}))
+        return out
+
+    def relu(self, t: TensorRef) -> TensorRef:
+        return self._activation(t, "relu")
+
+    def sigmoid(self, t: TensorRef) -> TensorRef:
+        return self._activation(t, "sigmoid")
+
+    def _activation(self, t: TensorRef, mode: str) -> TensorRef:
+        out = self._new(t.dim)
+        self.ops.append(OpNode("activation", (t.id,), out.id, {"mode": mode}))
+        return out
+
+    def add(self, a: TensorRef, b: TensorRef) -> TensorRef:
+        assert a.dim == b.dim
+        out = self._new(a.dim)
+        self.ops.append(OpNode("add", (a.id, b.id), out.id, {}))
+        return out
+
+    def softmax_cross_entropy(self, t: TensorRef) -> TensorRef:
+        """Marks ``t`` as the logits tensor.  Loss/metrics themselves live in
+        roc_tpu.ops.softmax (the reference's fwd is a no-op in train mode
+        too, softmax.cc:45-55)."""
+        self.logits = t
+        return t
+
+    # -- parameters -------------------------------------------------------
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        """Glorot-uniform per linear op, one fold_in per parameter —
+        mirroring the driver's one-srand-seed-many-draws structure
+        (initializer.cc:38)."""
+        params = {}
+        i = 0
+        for op in self.ops:
+            if op.kind == "linear":
+                k = jax.random.fold_in(key, i)
+                params[op.attrs["param"]] = ops.glorot_uniform(
+                    k, op.attrs["in_dim"], op.attrs["out_dim"])
+                i += 1
+        return params
+
+    # -- execution --------------------------------------------------------
+    def apply(self, params: Dict[str, Any], x: jnp.ndarray, gctx: GraphCtx,
+              key=None, train: bool = False) -> jnp.ndarray:
+        """Run the op list; returns logits ([N_local, C])."""
+        vals: Dict[int, jnp.ndarray] = {0: x}
+        for op in self.ops:
+            a = vals[op.inputs[0]]
+            if op.kind == "dropout":
+                if train:
+                    assert key is not None, "training dropout needs a PRNG key"
+                    k = jax.random.fold_in(key, op.attrs["slot"])
+                else:
+                    k = None
+                out = ops.dropout(k, a, op.attrs["rate"], train)
+            elif op.kind == "linear":
+                out = ops.linear(a, params[op.attrs["param"]],
+                                 op.attrs["activation"])
+            elif op.kind == "norm":
+                out = ops.indegree_norm(a, gctx.in_degree)
+            elif op.kind == "aggregate":
+                out = gctx.aggregate(a, op.attrs["aggr"])
+            elif op.kind == "activation":
+                out = ops.apply_activation(a, op.attrs["mode"])
+            elif op.kind == "add":
+                out = ops.add(a, vals[op.inputs[1]])
+            else:
+                raise ValueError(f"unknown op kind {op.kind!r}")
+            vals[op.out] = out
+        assert self.logits is not None, "call softmax_cross_entropy() last"
+        return vals[self.logits.id]
+
+    def loss(self, params, x, labels, mask, gctx, key=None,
+             train: bool = True):
+        logits = self.apply(params, x, gctx, key=key, train=train)
+        return ops.masked_softmax_cross_entropy(logits, labels, mask)

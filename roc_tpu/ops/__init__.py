@@ -1,0 +1,16 @@
+from roc_tpu.ops.aggregate import scatter_gather
+from roc_tpu.ops.norm import indegree_norm
+from roc_tpu.ops.linear import linear
+from roc_tpu.ops.activation import apply_activation, relu, sigmoid
+from roc_tpu.ops.element import add, mul
+from roc_tpu.ops.dropout import dropout
+from roc_tpu.ops.softmax import (
+    PerfMetrics, masked_softmax_cross_entropy, perf_metrics)
+from roc_tpu.ops.init import glorot_uniform
+
+__all__ = [
+    "scatter_gather", "indegree_norm", "linear", "relu", "sigmoid",
+    "apply_activation", "add",
+    "mul", "dropout", "PerfMetrics", "masked_softmax_cross_entropy",
+    "perf_metrics", "glorot_uniform",
+]

@@ -1,0 +1,29 @@
+"""Standalone activations (the reference's Activation op).
+
+cuDNN activationForward/Backward (activation_kernel.cu:64-66, 128-132) for
+the ActiMode enum (gnn.h:82-86): NONE / RELU / SIGMOID.  On TPU these are
+single VPU elementwise ops; backward comes from autodiff.
+"""
+
+from __future__ import annotations
+
+import jax.nn
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jnp.maximum(x, 0.0)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def apply_activation(x, mode: str):
+    if mode == "none":
+        return x
+    if mode == "relu":
+        return relu(x)
+    if mode == "sigmoid":
+        return sigmoid(x)
+    raise ValueError(f"unknown activation {mode!r}")

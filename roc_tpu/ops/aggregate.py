@@ -1,0 +1,52 @@
+"""Sparse neighborhood aggregation (the reference's ScatterGather op).
+
+Semantics (scattergather_kernel.cu:20-76): for every destination vertex v,
+``out[v] = Σ_{e : dst(e)=v} x[src(e)]`` — a sum over in-edges.  The reference
+runs a block-cooperative CUDA kernel with a CUB prefix-scan; on TPU the same
+contraction is a gather + sorted segment-sum, which XLA lowers to efficient
+dynamic-slice/scatter loops, and which Pallas re-implements as a blocked CSR
+kernel for the hot path (roc_tpu/ops/pallas/segment_sum.py).
+
+Backward needs no hand-written task pair (the reference reuses its forward
+kernel on the transposed role, scattergather_kernel.cu:160-170): JAX
+autodiff of gather+segment_sum *is* the transposed aggregation.
+
+Aggregation variants (AggrType, gnn.h:77-81 — the reference enumerates
+AVG/MAX/MIN/SUM but only wires SUM): all four are provided here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
+    """out[v] = aggr over in-edges of x[src].
+
+    Args:
+      x: [N_table, H] source feature table (may be larger than num_nodes when
+         it includes halo/remote rows).
+      edge_src: [E] int indices into x.
+      edge_dst: [E] int destination rows, sorted ascending (CSR order).
+      num_nodes: number of output rows (static).
+      aggr: one of sum/avg/max/min.
+    """
+    gathered = jnp.take(x, edge_src, axis=0)
+    if aggr == "sum":
+        return jax.ops.segment_sum(gathered, edge_dst, num_segments=num_nodes,
+                                   indices_are_sorted=True)
+    if aggr == "avg":
+        s = jax.ops.segment_sum(gathered, edge_dst, num_segments=num_nodes,
+                                indices_are_sorted=True)
+        cnt = jax.ops.segment_sum(jnp.ones_like(edge_dst, dtype=x.dtype),
+                                  edge_dst, num_segments=num_nodes,
+                                  indices_are_sorted=True)
+        return s / jnp.maximum(cnt, 1.0)[:, None]
+    if aggr == "max":
+        return jax.ops.segment_max(gathered, edge_dst, num_segments=num_nodes,
+                                   indices_are_sorted=True)
+    if aggr == "min":
+        return jax.ops.segment_min(gathered, edge_dst, num_segments=num_nodes,
+                                   indices_are_sorted=True)
+    raise ValueError(f"unknown aggr {aggr!r}")

@@ -1,0 +1,23 @@
+"""Parameter initializers (the reference's Initializer hierarchy).
+
+GlorotUniform: uniform(0,1) rescaled to ±sqrt(6/(fan_in+fan_out))
+(initializer_kernel.cu:38-48, scale_kernel mapping u -> (b-a)u + a); the
+driver seeds std::rand once and each weight draws a fresh seed
+(initializer.cc:38).  We mirror that structure with jax.random: one root key,
+`fold_in` per parameter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def glorot_uniform(key, in_dim: int, out_dim: int, dtype=jnp.float32):
+    scale = (6.0 / (in_dim + out_dim)) ** 0.5
+    return jax.random.uniform(key, (in_dim, out_dim), dtype=dtype,
+                              minval=-scale, maxval=scale)
+
+
+def zeros(shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype=dtype)

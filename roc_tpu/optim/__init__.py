@@ -1,0 +1,3 @@
+from roc_tpu.optim.adam import Adam, AdamState
+
+__all__ = ["Adam", "AdamState"]

@@ -1,0 +1,4 @@
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+__all__ = ["Config", "Trainer"]

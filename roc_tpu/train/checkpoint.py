@@ -1,0 +1,57 @@
+"""Checkpoint / resume (capability the reference lacks, SURVEY.md §5.4 —
+weights there live only in GPU framebuffers and every run starts from Glorot
+init).  Plain .npz of the flattened param/optimizer pytrees plus host-side
+training state; no external deps, works for multi-MB GNN weights.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    leaves, _ = jax.tree.flatten(tree)
+    return {f"leaf_{i}": np.asarray(jax.device_get(x))
+            for i, x in enumerate(leaves)}
+
+
+def _unflatten(tree_like, arrays: Dict[str, np.ndarray]):
+    leaves, treedef = jax.tree.flatten(tree_like)
+    new = [arrays[f"leaf_{i}"] for i in range(len(leaves))]
+    return jax.tree.unflatten(treedef, new)
+
+
+def save(path: str, params, opt_state, epoch: int, alpha: float,
+         extra: Dict[str, Any] | None = None) -> None:
+    """Atomic save (write tmp + rename) of params + optimizer + host state."""
+    meta = {"version": _FORMAT_VERSION, "epoch": epoch, "alpha": alpha,
+            "extra": extra or {}}
+    payload = {f"p_{k}": v for k, v in _flatten(params).items()}
+    payload.update({f"o_{k}": v for k, v in _flatten(opt_state).items()})
+    payload["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+
+
+def load(path: str, params_like, opt_state_like
+         ) -> Tuple[Any, Any, int, float, Dict[str, Any]]:
+    """Restore into the same pytree structure as `params_like`/`opt_state_like`."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        assert meta["version"] == _FORMAT_VERSION, (
+            f"checkpoint version {meta['version']} != {_FORMAT_VERSION}")
+        p = {k[2:]: z[k] for k in z.files if k.startswith("p_")}
+        o = {k[2:]: z[k] for k in z.files if k.startswith("o_")}
+    params = _unflatten(params_like, p)
+    opt_state = _unflatten(opt_state_like, o)
+    return params, opt_state, meta["epoch"], meta["alpha"], meta["extra"]

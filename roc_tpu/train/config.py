@@ -1,0 +1,83 @@
+"""Run configuration + CLI, mirroring the reference's flags.
+
+Reference parse_input_args (gnn.cc:114-179) and defaults (gnn.cc:31-40):
+  -e / -epoch N        epochs (default 1)
+  -lr F                learning rate (default 0.01)
+  -dropout F           dropout rate (default 0.5)
+  -decay / -wd F       weight decay (default 0.05)
+  -decay-rate F        LR decay factor (default 1.0)
+  -decay-step / -ds N  LR decay interval in epochs (default 100)
+  -seed N              RNG seed (default 1)
+  -file S              dataset prefix (ROC on-disk format)
+  -layers H0-H1-...    layer widths incl. input and classes (e.g. 602-256-41)
+  -ng / -ll:gpu N      devices per machine → we take -parts (total shards)
+  -v                   verbose
+
+The reference double-binds `-dr` to both dropout and decay-rate
+(gnn.cc:138-152) — a latent CLI bug we do NOT reproduce; use the long names.
+TPU-only additions: -parts, -dataset (synthetic registry name), -aggr,
+-model, -ckpt/-resume, -bf16.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class Config:
+    filename: str = ""            # ROC-format dataset prefix (-file)
+    dataset: str = ""             # synthetic registry name (TPU addition)
+    layers: List[int] = dataclasses.field(default_factory=list)
+    num_epochs: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 0.05
+    dropout_rate: float = 0.5
+    decay_rate: float = 1.0
+    decay_steps: int = 100
+    seed: int = 1
+    num_parts: int = 1            # total shards (== mesh size when > 1)
+    model: str = "gcn"            # gcn | sage | gin
+    aggr: str = "sum"
+    verbose: bool = False
+    eval_every: int = 5           # reference evaluates every 5 epochs (gnn.cc:107)
+    checkpoint_path: Optional[str] = None
+    checkpoint_every: int = 0     # 0 = disabled
+    resume: bool = False
+    use_bf16: bool = False        # opt-in activation bf16 (SURVEY §7 non-goal note)
+    halo: bool = True             # v1 halo exchange vs v0 all_gather
+
+
+def parse_args(argv: List[str]) -> Config:
+    p = argparse.ArgumentParser(
+        prog="roc_tpu", description="TPU-native full-graph GNN training")
+    p.add_argument("-file", dest="filename", default="")
+    p.add_argument("-dataset", default="")
+    p.add_argument("-layers", default="",
+                   help="dash-separated widths, e.g. 602-256-41")
+    p.add_argument("-e", "-epoch", dest="num_epochs", type=int, default=1)
+    p.add_argument("-lr", dest="learning_rate", type=float, default=0.01)
+    p.add_argument("-dropout", dest="dropout_rate", type=float, default=0.5)
+    p.add_argument("-decay", "-wd", dest="weight_decay", type=float, default=0.05)
+    p.add_argument("-decay-rate", dest="decay_rate", type=float, default=1.0)
+    p.add_argument("-decay-step", "-ds", dest="decay_steps", type=int, default=100)
+    p.add_argument("-seed", type=int, default=1)
+    p.add_argument("-parts", "-ng", "-ll:gpu", dest="num_parts", type=int,
+                   default=1)
+    p.add_argument("-model", default="gcn", choices=["gcn", "sage", "gin"])
+    p.add_argument("-aggr", default="sum", choices=["sum", "avg", "max", "min"])
+    p.add_argument("-v", dest="verbose", action="store_true")
+    p.add_argument("-eval-every", dest="eval_every", type=int, default=5)
+    p.add_argument("-ckpt", dest="checkpoint_path", default=None)
+    p.add_argument("-ckpt-every", dest="checkpoint_every", type=int, default=0)
+    p.add_argument("-resume", action="store_true")
+    p.add_argument("-bf16", dest="use_bf16", action="store_true")
+    p.add_argument("-no-halo", dest="halo", action="store_false")
+    ns = p.parse_args(argv)
+    cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
+                    for f in dataclasses.fields(Config)})
+    if ns.layers:
+        cfg.layers = [int(x) for x in ns.layers.split("-")]  # gnn.cc:168-177
+    return cfg

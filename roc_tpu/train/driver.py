@@ -1,0 +1,148 @@
+"""Training driver (the reference's top_level_task epoch loop, gnn.cc:99-111).
+
+Per epoch:
+  * every decay_steps epochs (not epoch 0) multiply LR by decay_rate
+    (gnn.cc:100-101 — decay applied to optimizer->alpha on the host);
+  * one fused train step: forward + backward + Adam (one jitted function —
+    the analog of zero_gradients/forward/backward/update, except XLA fuses
+    the whole epoch into one executable instead of per-op task launches);
+  * every `eval_every` epochs an inference forward pass computes and prints
+    the reference's metric line (gnn.cc:107-110 → softmax_kernel.cu:141-152).
+
+Single-device path lives here; the multi-chip path (mesh + shard_map) is
+roc_tpu/parallel/spmd.py and plugs in through the same Trainer interface.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from roc_tpu import ops
+from roc_tpu.graph.datasets import Dataset
+from roc_tpu.models.model import GraphCtx, Model
+from roc_tpu.ops.softmax import format_metrics
+from roc_tpu.optim.adam import Adam
+from roc_tpu.train.config import Config
+
+
+class DenseGraphData(NamedTuple):
+    """Single-device edge arrays (a pytree, passed as jit args so the edge
+    lists are runtime buffers, not compile-time constants)."""
+    edge_src: jnp.ndarray   # [E] int32
+    edge_dst: jnp.ndarray   # [E] int32, sorted
+    in_degree: jnp.ndarray  # [N] float32
+
+
+def dense_graph_data(graph) -> DenseGraphData:
+    return DenseGraphData(
+        edge_src=jnp.asarray(graph.col_idx, jnp.int32),
+        edge_dst=jnp.asarray(graph.dst_idx, jnp.int32),
+        in_degree=jnp.asarray(graph.in_degrees, jnp.float32),
+    )
+
+
+def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
+    def aggregate(x, aggr):
+        return ops.scatter_gather(x, g.edge_src, g.edge_dst, num_nodes, aggr)
+    return GraphCtx(aggregate=aggregate, in_degree=g.in_degree)
+
+
+class Trainer:
+    """Single-device full-graph trainer."""
+
+    def __init__(self, config: Config, dataset: Dataset, model: Model):
+        self.config = config
+        self.dataset = dataset
+        self.model = model
+        self.optimizer = Adam(alpha=config.learning_rate,
+                              weight_decay=config.weight_decay)
+        self.gdata = dense_graph_data(dataset.graph)
+        dtype = jnp.bfloat16 if config.use_bf16 else jnp.float32
+        self.x = jnp.asarray(dataset.features, dtype)
+        self.labels = jnp.asarray(dataset.labels, jnp.float32)
+        self.mask = jnp.asarray(dataset.mask, jnp.int32)
+        key = jax.random.PRNGKey(config.seed)
+        self.params = model.init_params(key)
+        self.opt_state = self.optimizer.init(self.params)
+        self.key = key
+        self.epoch = 0
+        self.num_nodes = dataset.graph.num_nodes
+
+        n = self.num_nodes
+
+        @jax.jit
+        def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
+            gctx = make_gctx(gdata, n)
+            loss, grads = jax.value_and_grad(self.model.loss)(
+                params, x, labels, mask, gctx, key=key, train=True)
+            params, opt_state = self.optimizer.update(
+                params, grads, opt_state, alpha)
+            return params, opt_state, loss
+
+        @jax.jit
+        def eval_step(params, x, labels, mask, gdata):
+            gctx = make_gctx(gdata, n)
+            logits = self.model.apply(params, x, gctx, train=False)
+            return ops.perf_metrics(logits, labels, mask)
+
+        self._train_step = train_step
+        self._eval_step = eval_step
+
+        if config.resume and config.checkpoint_path and \
+                os.path.exists(config.checkpoint_path):
+            self.restore(config.checkpoint_path)
+
+    # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
+    def save_checkpoint(self, path: str):
+        from roc_tpu.train import checkpoint
+        checkpoint.save(path, self.params, self.opt_state, self.epoch,
+                        self.optimizer.alpha)
+
+    def restore(self, path: str):
+        from roc_tpu.train import checkpoint
+        self.params, self.opt_state, self.epoch, self.optimizer.alpha, _ = \
+            checkpoint.load(path, self.params, self.opt_state)
+
+    def run_epoch(self):
+        cfg = self.config
+        if self.epoch != 0 and self.epoch % cfg.decay_steps == 0:
+            self.optimizer.alpha *= cfg.decay_rate  # gnn.cc:100-101
+        step_key = jax.random.fold_in(self.key, self.epoch)
+        self.params, self.opt_state, loss = self._train_step(
+            self.params, self.opt_state, self.x, self.labels, self.mask,
+            self.gdata, step_key, jnp.float32(self.optimizer.alpha))
+        self.epoch += 1
+        return loss
+
+    def evaluate(self, epoch: Optional[int] = None) -> ops.PerfMetrics:
+        return self._eval_step(self.params, self.x, self.labels, self.mask,
+                               self.gdata)
+
+    def train(self, print_fn=print):
+        cfg = self.config
+        num_edges = self.dataset.graph.num_edges
+        t0 = time.perf_counter()
+        start = self.epoch
+        for epoch in range(start, start + cfg.num_epochs):
+            self.run_epoch()
+            if epoch % cfg.eval_every == 0:
+                m = jax.device_get(self.evaluate())
+                print_fn(format_metrics(epoch, m))
+            if (cfg.checkpoint_path and cfg.checkpoint_every and
+                    (epoch + 1) % cfg.checkpoint_every == 0):
+                self.save_checkpoint(cfg.checkpoint_path)
+        jax.block_until_ready(self.params)
+        if cfg.checkpoint_path:
+            self.save_checkpoint(cfg.checkpoint_path)
+        dt = time.perf_counter() - t0
+        if cfg.verbose:
+            eps = cfg.num_epochs * num_edges / dt
+            print_fn(f"# {cfg.num_epochs} epochs in {dt:.2f}s "
+                     f"({dt / cfg.num_epochs * 1e3:.1f} ms/epoch, "
+                     f"{eps / 1e6:.1f}M edges/s)")
+        return self

@@ -1,0 +1,39 @@
+"""Test harness: force an 8-virtual-device CPU platform.
+
+This is the TPU analog of the reference's parts>GPUs trick (numParts =
+numMachines*numGPUs, gnn.cc:61-63, lets distributed code paths run on one
+box): XLA's host platform is split into 8 virtual devices so every
+mesh/collective path is exercised on CPU-only CI.
+
+The environment may carry a TPU PJRT plugin (registered by sitecustomize
+before pytest starts) whose initialization dials a remote chip; tests must
+never depend on — or block on — that tunnel, so we (a) pin the platform to
+cpu via jax.config (env vars are too late: the plugin's own registration can
+override JAX_PLATFORMS programmatically) and (b) drop any non-cpu backend
+factories before first use.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge
+
+    for _name in [n for n in xla_bridge._backend_factories if n != "cpu"]:
+        xla_bridge._backend_factories.pop(_name, None)
+except Exception:  # pragma: no cover - private API may move across versions
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
