@@ -1,0 +1,76 @@
+"""Benchmark: full-graph GCN training throughput (the reference's canonical
+workload, test.sh:8 — 2-layer GCN, Reddit-shaped graph, layers 602-256-41).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+The graph is a deterministic synthetic Reddit-scale stand-in (zero-egress
+environment; same node/feature/class counts as reddit-dgl, ~23.5M in-edges).
+Metric is wall-clock per training epoch (fwd+bwd+Adam, full graph, no
+sampling).  vs_baseline compares against REF_EPOCH_S, the reference system's
+single-GPU epoch time for this workload; the reference repo publishes no
+numbers (BASELINE.md), so REF_EPOCH_S holds the MLSys'20 paper's reported
+~1 s/epoch for single-GPU full-graph Reddit until a measured value replaces
+it.  vs_baseline > 1 means faster than that reference number.
+"""
+
+import json
+import sys
+import time
+
+REF_EPOCH_S = 1.0  # assumed reference (see module docstring); >1.0 = we win
+
+NODES, IN_DIM, CLASSES = 232_965, 602, 41
+LAYERS = [IN_DIM, 256, CLASSES]
+AVG_DEG = 50.0
+WARMUP, MEASURED = 3, 10
+
+
+def main():
+    import jax
+
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    t0 = time.time()
+    ds = datasets.synthetic(
+        "reddit-bench", NODES, AVG_DEG, IN_DIM, CLASSES,
+        n_train=153431, n_val=23831, n_test=55703, seed=1)
+    print(f"# graph ready: {ds.graph.num_nodes} nodes "
+          f"{ds.graph.num_edges} edges ({time.time()-t0:.1f}s)",
+          file=sys.stderr)
+
+    n_dev = len(jax.devices())
+    cfg = Config(layers=LAYERS, num_epochs=1, learning_rate=0.01,
+                 weight_decay=1e-4, dropout_rate=0.5, eval_every=10**9,
+                 num_parts=n_dev, halo=True)
+    if n_dev > 1:
+        from roc_tpu.parallel.spmd import SpmdTrainer
+        trainer = SpmdTrainer(cfg, ds, build_gcn(LAYERS, cfg.dropout_rate))
+    else:
+        trainer = Trainer(cfg, ds, build_gcn(LAYERS, cfg.dropout_rate))
+
+    for _ in range(WARMUP):
+        trainer.run_epoch()
+    jax.block_until_ready(trainer.params)
+    t1 = time.perf_counter()
+    for _ in range(MEASURED):
+        trainer.run_epoch()
+    jax.block_until_ready(trainer.params)
+    epoch_s = (time.perf_counter() - t1) / MEASURED
+
+    edges_per_sec_per_chip = ds.graph.num_edges / epoch_s / n_dev
+    print(f"# {epoch_s*1e3:.1f} ms/epoch on {n_dev} device(s), "
+          f"{edges_per_sec_per_chip/1e6:.1f}M edges/s/chip", file=sys.stderr)
+    print(json.dumps({
+        "metric": "gcn_reddit602-256-41_epoch_time",
+        "value": round(epoch_s, 4),
+        "unit": "s",
+        "vs_baseline": round(REF_EPOCH_S / epoch_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

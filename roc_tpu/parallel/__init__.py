@@ -1,0 +1,6 @@
+from roc_tpu.parallel.halo import HaloMaps, build_halo_maps
+from roc_tpu.parallel.mesh import make_mesh
+from roc_tpu.parallel.spmd import ShardedGraphData, SpmdTrainer, shard_graph
+
+__all__ = ["HaloMaps", "build_halo_maps", "make_mesh", "ShardedGraphData",
+           "SpmdTrainer", "shard_graph"]

@@ -1,0 +1,83 @@
+"""Halo index maps: which rows each shard must receive from each other shard.
+
+This is the v1 comms plan (SURVEY.md §2.1 "activation halo exchange" north
+star).  The reference sidesteps the problem by having every partition read
+the ENTIRE node tensor through Legion zero-copy coherence
+(scattergather.cc:69-73) — O(N) bytes per device per layer.  Here we
+precompute, once at partition time, exactly which remote rows each shard's
+in-edges touch, and exchange only those via one `all_to_all` per aggregation
+— O(halo) bytes riding ICI.
+
+Layout (P shards, K = max rows any ordered pair exchanges, padded):
+  send_idx[q, p, :]   local row indices in shard q that shard p needs
+                      (sorted, padded with S-1 — a guaranteed pad row whose
+                      features are zero)
+  edge_src_local[p,:] per-edge source index into shard p's *combined table*
+                      [own shard (S rows) ++ recv buffer (P*K rows)]:
+                      own sources stay in [0, S); a remote source owned by q
+                      at send position j maps to S + q*K + j.
+
+The exchange itself (roc_tpu/parallel/spmd.py) is then:
+  send = x[send_idx[q]]                 # [P, K, H]  gather on the VPU
+  recv = lax.all_to_all(send, 'parts', split_axis=0, concat_axis=0)
+  table = concat([x, recv.reshape(P*K, H)])
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from roc_tpu.graph.partition import Partition
+
+
+@dataclasses.dataclass(frozen=True)
+class HaloMaps:
+    K: int
+    send_idx: np.ndarray        # [P, P, K] int32
+    edge_src_local: np.ndarray  # [P, E] int32 into the combined table
+    halo_rows_total: int        # live (unpadded) remote rows exchanged
+
+
+def build_halo_maps(part: Partition) -> HaloMaps:
+    P, S, E = part.num_parts, part.shard_nodes, part.shard_edges
+    send_lists = [[np.empty(0, np.int64) for _ in range(P)] for _ in range(P)]
+    # Pass 1: per (dest p, owner q) unique remote locals.
+    uniq_cache = []
+    for p in range(P):
+        src = part.edge_src[p]
+        owner = src // S
+        remote = owner != p
+        per_owner = {}
+        for q in np.unique(owner[remote]):
+            locals_q = np.unique(src[remote & (owner == q)] - q * S)
+            per_owner[int(q)] = locals_q
+            send_lists[int(q)][p] = locals_q
+        uniq_cache.append(per_owner)
+    halo_total = sum(len(v) for per in uniq_cache for v in per.values())
+    K = max([len(v) for per in uniq_cache for v in per.values()] + [1])
+
+    send_idx = np.full((P, P, K), S - 1, dtype=np.int32)
+    for q in range(P):
+        for p in range(P):
+            rows = send_lists[q][p]
+            send_idx[q, p, : len(rows)] = rows
+
+    # Pass 2: remap edge sources into the combined table.
+    edge_src_local = np.empty((P, E), dtype=np.int32)
+    for p in range(P):
+        src = part.edge_src[p]
+        owner = (src // S).astype(np.int64)
+        local = (src - owner * S).astype(np.int64)
+        out = np.empty(E, dtype=np.int64)
+        own = owner == p
+        out[own] = local[own]
+        for q, rows in uniq_cache[p].items():
+            sel = owner == q
+            # position of each remote local within q's (sorted) send list
+            pos = np.searchsorted(rows, local[sel])
+            out[sel] = S + q * K + pos
+        edge_src_local[p] = out
+    return HaloMaps(K=K, send_idx=send_idx, edge_src_local=edge_src_local,
+                    halo_rows_total=halo_total)

@@ -1,0 +1,29 @@
+"""Device mesh construction (replaces the reference's GnnMapper placement).
+
+The reference's mapper round-robins per-partition point tasks across
+machines then GPUs and caches the placement (gnn_mapper.cc:88-134).  On TPU
+the equivalent decision is a 1-D `jax.sharding.Mesh` over the vertex-shard
+axis; XLA's SPMD partitioner owns placement from there.  Multi-host pods
+arrive the same way: `jax.distributed.initialize()` + the global device list
+— DCN-connected hosts simply contribute more devices to the same axis.
+"""
+
+from __future__ import annotations
+
+import jax
+
+PARTS_AXIS = "parts"
+
+
+def make_mesh(num_parts: int, devices=None) -> jax.sharding.Mesh:
+    """1-D mesh with `num_parts` devices along the 'parts' axis.
+
+    num_parts must equal the device count used (the reference's
+    parts-per-GPU overcommit trick, gnn.cc:61-63, is reproduced in tests
+    via XLA's virtual host devices instead of task multiplexing).
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    assert num_parts <= len(devices), (
+        f"num_parts={num_parts} exceeds available devices={len(devices)}; "
+        "for local testing raise --xla_force_host_platform_device_count")
+    return jax.sharding.Mesh(devices[:num_parts], (PARTS_AXIS,))

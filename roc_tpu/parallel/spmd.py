@@ -1,0 +1,173 @@
+"""SPMD multi-chip training over a 1-D vertex-shard mesh.
+
+The TPU-native replacement for the reference's entire distribution stack
+(SURVEY.md §5.8): where ROC maps whole node tensors into every node's
+zero-copy memory and lets Legion's coherence move the bytes
+(scattergather.cc:69-73), we shard every node tensor over the mesh's
+'parts' axis and exchange exactly what aggregation needs with explicit ICI
+collectives inside one `shard_map`-ped train step:
+
+  v0 (`halo=False`): `all_gather` the shard's activations — byte-equivalent
+      to the reference's full replication, one collective per aggregation.
+  v1 (`halo=True`, default): gather only the rows other shards reference,
+      via precomputed halo maps + one `all_to_all` (roc_tpu/parallel/halo.py).
+
+Gradients: `psum` over 'parts' (replaces the reference's gather-all-replicas-
+to-one-GPU serial sum, optimizer_kernel.cu:88-94); Adam then runs replicated
+on every chip — same math, no single-device bottleneck.  Backward of the
+halo exchange is AD's transpose of the collective (the reference hand-wrote
+this as "same kernel, transposed roles", scattergather_kernel.cu:160-170).
+
+Multi-host: the same code runs under `jax.distributed.initialize()`; the
+'parts' axis then spans hosts and XLA routes the same collectives over
+ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from roc_tpu import ops
+from roc_tpu.graph.partition import Partition, partition_graph
+from roc_tpu.models.model import GraphCtx
+from roc_tpu.parallel.halo import HaloMaps, build_halo_maps
+from roc_tpu.ops.softmax import MASK_NONE
+from roc_tpu.parallel.mesh import PARTS_AXIS, make_mesh
+from roc_tpu.train.driver import BaseTrainer
+
+
+class ShardedGraphData(NamedTuple):
+    """Per-shard edge arrays, leading axis = 'parts' (sharded)."""
+    edge_src: jnp.ndarray            # [P, E] int32 (table-local for halo,
+                                     #              padded-global for v0)
+    edge_dst: jnp.ndarray            # [P, E] int32, ascending per shard
+    in_degree: jnp.ndarray           # [P, S] float32
+    send_idx: Optional[jnp.ndarray]  # [P, P, K] int32, halo mode only
+
+
+def shard_graph(part: Partition, halo: Optional[HaloMaps]) -> ShardedGraphData:
+    if halo is not None:
+        src = halo.edge_src_local
+    else:
+        src = part.edge_src.astype(np.int32)
+    return ShardedGraphData(
+        edge_src=jnp.asarray(src, jnp.int32),
+        edge_dst=jnp.asarray(part.edge_dst, jnp.int32),
+        in_degree=jnp.asarray(part.in_degree, jnp.float32),
+        send_idx=None if halo is None else jnp.asarray(halo.send_idx),
+    )
+
+
+def _shard_aggregate_fn(gd_block, shard_nodes: int, use_halo: bool):
+    """Build the per-shard GraphCtx.aggregate closure (runs inside shard_map;
+    gd_block fields already have the leading parts-axis block squeezed)."""
+    edge_src, edge_dst = gd_block.edge_src, gd_block.edge_dst
+
+    def aggregate(x, aggr):
+        if use_halo:
+            send = jnp.take(x, gd_block.send_idx, axis=0)       # [P, K, H]
+            recv = jax.lax.all_to_all(send, PARTS_AXIS,
+                                      split_axis=0, concat_axis=0)
+            table = jnp.concatenate(
+                [x, recv.reshape(-1, x.shape[-1])], axis=0)     # [S+P*K, H]
+        else:
+            table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)  # [P*S, H]
+        return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
+                                  aggr)
+    return aggregate
+
+
+def _squeeze_gd(gd: ShardedGraphData) -> ShardedGraphData:
+    """Drop the size-1 parts-axis block dim that shard_map leaves on each
+    per-device block."""
+    return ShardedGraphData(
+        edge_src=gd.edge_src[0], edge_dst=gd.edge_dst[0],
+        in_degree=gd.in_degree[0],
+        send_idx=None if gd.send_idx is None else gd.send_idx[0])
+
+
+class SpmdTrainer(BaseTrainer):
+    """Multi-chip trainer: same Trainer interface, mesh underneath."""
+
+    def _setup(self):
+        cfg, ds, model = self.config, self.dataset, self.model
+        P_ = cfg.num_parts
+        self.part = partition_graph(ds.graph, P_)
+        self.halo = build_halo_maps(self.part) if cfg.halo else None
+        self.mesh = make_mesh(P_)
+        S = self.part.shard_nodes
+
+        node_spec = NamedSharding(self.mesh, P(PARTS_AXIS))
+        repl_spec = NamedSharding(self.mesh, P())
+
+        # Node tensors: [P*S, ...], padded + permuted, sharded on axis 0.
+        pad = self.part.pad_nodes
+        self.x = jax.device_put(
+            pad(ds.features).astype(self.dtype), node_spec)
+        self.labels = jax.device_put(pad(ds.labels), node_spec)
+        # Pad rows get MASK_NONE so they never count in loss or metrics.
+        self.mask = jax.device_put(
+            pad(ds.mask, fill=MASK_NONE).astype(np.int32), node_spec)
+
+        gd = shard_graph(self.part, self.halo)
+        self.gdata = jax.tree.map(  # None (no send_idx) passes through
+            lambda a: jax.device_put(a, node_spec), gd)
+
+        self.params = jax.device_put(model.init_params(self.key), repl_spec)
+        self.opt_state = jax.device_put(self.optimizer.init(self.params),
+                                        repl_spec)
+
+        use_halo = self.halo is not None
+        optimizer = self.optimizer
+
+        def local_loss(params, x, labels, mask, gd_block, key):
+            gctx = GraphCtx(
+                aggregate=_shard_aggregate_fn(gd_block, S, use_halo),
+                in_degree=gd_block.in_degree)
+            return model.loss(params, x, labels, mask, gctx, key=key,
+                              train=True)
+
+        gd_specs = ShardedGraphData(
+            edge_src=P(PARTS_AXIS), edge_dst=P(PARTS_AXIS),
+            in_degree=P(PARTS_AXIS),
+            send_idx=None if gd.send_idx is None else P(PARTS_AXIS))
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(P(), P(), P(PARTS_AXIS), P(PARTS_AXIS),
+                           P(PARTS_AXIS), gd_specs, P(), P()),
+                 out_specs=(P(), P(), P()))
+        def step_shard(params, opt_state, x, labels, mask, gd, key, alpha):
+            gd = _squeeze_gd(gd)
+            # per-shard dropout masks: fold the shard index into the key
+            key = jax.random.fold_in(key, jax.lax.axis_index(PARTS_AXIS))
+            loss_l, grads_l = jax.value_and_grad(local_loss)(
+                params, x, labels, mask, gd, key)
+            # all-reduce over ICI (replaces gather-to-one-GPU + serial sum)
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, PARTS_AXIS),
+                                 grads_l)
+            loss = jax.lax.psum(loss_l, PARTS_AXIS)
+            new_params, new_opt = optimizer.update(params, grads, opt_state,
+                                                   alpha)
+            return new_params, new_opt, loss
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(P(), P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS),
+                           gd_specs),
+                 out_specs=P())
+        def eval_shard(params, x, labels, mask, gd):
+            gd = _squeeze_gd(gd)
+            gctx = GraphCtx(
+                aggregate=_shard_aggregate_fn(gd, S, use_halo),
+                in_degree=gd.in_degree)
+            logits = model.apply(params, x, gctx, train=False)
+            m = ops.perf_metrics(logits, labels, mask)
+            return jax.tree.map(lambda v: jax.lax.psum(v, PARTS_AXIS), m)
+
+        self._train_step = jax.jit(step_shard, donate_argnums=(0, 1))
+        self._eval_step = jax.jit(eval_shard)

@@ -9,15 +9,15 @@ Per epoch:
   * every `eval_every` epochs an inference forward pass computes and prints
     the reference's metric line (gnn.cc:107-110 → softmax_kernel.cu:141-152).
 
-Single-device path lives here; the multi-chip path (mesh + shard_map) is
-roc_tpu/parallel/spmd.py and plugs in through the same Trainer interface.
+`Trainer` is the single-device path; `roc_tpu.parallel.spmd.SpmdTrainer`
+subclasses `BaseTrainer` for the mesh/shard_map path.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -52,8 +52,8 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
     return GraphCtx(aggregate=aggregate, in_degree=g.in_degree)
 
 
-class Trainer:
-    """Single-device full-graph trainer."""
+class BaseTrainer:
+    """Shared epoch loop, LR decay, metrics cadence, checkpointing."""
 
     def __init__(self, config: Config, dataset: Dataset, model: Model):
         self.config = config
@@ -61,67 +61,37 @@ class Trainer:
         self.model = model
         self.optimizer = Adam(alpha=config.learning_rate,
                               weight_decay=config.weight_decay)
-        self.gdata = dense_graph_data(dataset.graph)
-        dtype = jnp.bfloat16 if config.use_bf16 else jnp.float32
-        self.x = jnp.asarray(dataset.features, dtype)
-        self.labels = jnp.asarray(dataset.labels, jnp.float32)
-        self.mask = jnp.asarray(dataset.mask, jnp.int32)
-        key = jax.random.PRNGKey(config.seed)
-        self.params = model.init_params(key)
-        self.opt_state = self.optimizer.init(self.params)
-        self.key = key
+        self.key = jax.random.PRNGKey(config.seed)
         self.epoch = 0
-        self.num_nodes = dataset.graph.num_nodes
-
-        n = self.num_nodes
-
-        @jax.jit
-        def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
-            gctx = make_gctx(gdata, n)
-            loss, grads = jax.value_and_grad(self.model.loss)(
-                params, x, labels, mask, gctx, key=key, train=True)
-            params, opt_state = self.optimizer.update(
-                params, grads, opt_state, alpha)
-            return params, opt_state, loss
-
-        @jax.jit
-        def eval_step(params, x, labels, mask, gdata):
-            gctx = make_gctx(gdata, n)
-            logits = self.model.apply(params, x, gctx, train=False)
-            return ops.perf_metrics(logits, labels, mask)
-
-        self._train_step = train_step
-        self._eval_step = eval_step
-
+        self.dtype = jnp.bfloat16 if config.use_bf16 else jnp.float32
+        self._setup()
         if config.resume and config.checkpoint_path and \
                 os.path.exists(config.checkpoint_path):
             self.restore(config.checkpoint_path)
 
-    # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
-    def save_checkpoint(self, path: str):
-        from roc_tpu.train import checkpoint
-        checkpoint.save(path, self.params, self.opt_state, self.epoch,
-                        self.optimizer.alpha)
+    # subclasses: place data (x/labels/mask/gdata), init params/opt_state,
+    # and build the jitted self._train_step / self._eval_step
+    def _setup(self):
+        raise NotImplementedError
 
-    def restore(self, path: str):
-        from roc_tpu.train import checkpoint
-        self.params, self.opt_state, self.epoch, self.optimizer.alpha, _ = \
-            checkpoint.load(path, self.params, self.opt_state)
+    def _run_step(self, step_key, alpha):
+        self.params, self.opt_state, loss = self._train_step(
+            self.params, self.opt_state, self.x, self.labels, self.mask,
+            self.gdata, step_key, alpha)
+        return loss
+
+    def evaluate(self) -> ops.PerfMetrics:
+        return self._eval_step(self.params, self.x, self.labels, self.mask,
+                               self.gdata)
 
     def run_epoch(self):
         cfg = self.config
         if self.epoch != 0 and self.epoch % cfg.decay_steps == 0:
             self.optimizer.alpha *= cfg.decay_rate  # gnn.cc:100-101
         step_key = jax.random.fold_in(self.key, self.epoch)
-        self.params, self.opt_state, loss = self._train_step(
-            self.params, self.opt_state, self.x, self.labels, self.mask,
-            self.gdata, step_key, jnp.float32(self.optimizer.alpha))
+        loss = self._run_step(step_key, jnp.float32(self.optimizer.alpha))
         self.epoch += 1
         return loss
-
-    def evaluate(self, epoch: Optional[int] = None) -> ops.PerfMetrics:
-        return self._eval_step(self.params, self.x, self.labels, self.mask,
-                               self.gdata)
 
     def train(self, print_fn=print):
         cfg = self.config
@@ -137,12 +107,56 @@ class Trainer:
                     (epoch + 1) % cfg.checkpoint_every == 0):
                 self.save_checkpoint(cfg.checkpoint_path)
         jax.block_until_ready(self.params)
+        dt = time.perf_counter() - t0
         if cfg.checkpoint_path:
             self.save_checkpoint(cfg.checkpoint_path)
-        dt = time.perf_counter() - t0
         if cfg.verbose:
             eps = cfg.num_epochs * num_edges / dt
             print_fn(f"# {cfg.num_epochs} epochs in {dt:.2f}s "
                      f"({dt / cfg.num_epochs * 1e3:.1f} ms/epoch, "
                      f"{eps / 1e6:.1f}M edges/s)")
         return self
+
+    # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
+    def save_checkpoint(self, path: str):
+        from roc_tpu.train import checkpoint
+        checkpoint.save(path, self.params, self.opt_state, self.epoch,
+                        self.optimizer.alpha)
+
+    def restore(self, path: str):
+        from roc_tpu.train import checkpoint
+        self.params, self.opt_state, self.epoch, self.optimizer.alpha, _ = \
+            checkpoint.load(path, self.params, self.opt_state)
+
+
+class Trainer(BaseTrainer):
+    """Single-device full-graph trainer."""
+
+    def _setup(self):
+        ds, model = self.dataset, self.model
+        self.gdata = dense_graph_data(ds.graph)
+        self.x = jnp.asarray(ds.features, self.dtype)
+        self.labels = jnp.asarray(ds.labels, jnp.float32)
+        self.mask = jnp.asarray(ds.mask, jnp.int32)
+        self.params = model.init_params(self.key)
+        self.opt_state = self.optimizer.init(self.params)
+        self.num_nodes = ds.graph.num_nodes
+        n = self.num_nodes
+
+        @jax.jit
+        def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
+            gctx = make_gctx(gdata, n)
+            loss, grads = jax.value_and_grad(model.loss)(
+                params, x, labels, mask, gctx, key=key, train=True)
+            params, opt_state = self.optimizer.update(
+                params, grads, opt_state, alpha)
+            return params, opt_state, loss
+
+        @jax.jit
+        def eval_step(params, x, labels, mask, gdata):
+            gctx = make_gctx(gdata, n)
+            logits = model.apply(params, x, gctx, train=False)
+            return ops.perf_metrics(logits, labels, mask)
+
+        self._train_step = train_step
+        self._eval_step = eval_step
