@@ -1,4 +1,6 @@
-from roc_tpu.ops.aggregate import scatter_gather
+from roc_tpu.ops.aggregate import (
+    AggregatePlans, build_aggregate_plans, pad_plans, scatter_gather,
+    scatter_gather_pallas)
 from roc_tpu.ops.norm import indegree_norm
 from roc_tpu.ops.linear import linear
 from roc_tpu.ops.activation import apply_activation, relu, sigmoid

@@ -17,8 +17,12 @@ AVG/MAX/MIN/SUM but only wires SUM): all four are provided here.
 
 from __future__ import annotations
 
+from functools import partial
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
@@ -50,3 +54,110 @@ def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
         return jax.ops.segment_min(gathered, edge_dst, num_segments=num_nodes,
                                    indices_are_sorted=True)
     raise ValueError(f"unknown aggr {aggr!r}")
+
+
+# ---------------------------------------------------------------------------
+# Pallas backend (sum only): blocked CSR kernel + transposed-plan backward.
+# ---------------------------------------------------------------------------
+
+class AggregatePlans(NamedTuple):
+    """Fwd + transposed-bwd chunk schedules as jit-traceable arrays.
+
+    Kept as a flat NamedTuple of int32 arrays so it rides inside the graph-
+    data pytree passed to jitted steps (and can be stacked + sharded on a
+    leading parts axis for shard_map)."""
+    fwd_obi: jnp.ndarray    # [C_f]
+    fwd_first: jnp.ndarray  # [C_f]
+    fwd_edst: jnp.ndarray   # [C_f, EB]
+    fwd_esrc: jnp.ndarray   # [C_f, EB]
+    bwd_obi: jnp.ndarray    # [C_b]
+    bwd_first: jnp.ndarray  # [C_b]
+    bwd_edst: jnp.ndarray   # [C_b, EB]
+    bwd_esrc: jnp.ndarray   # [C_b, EB]
+
+
+def build_aggregate_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
+                          num_rows: int, table_rows: int) -> AggregatePlans:
+    """Chunk schedules for out = A@x (fwd) and grad_x = A^T@grad (bwd).
+
+    The transposed plan re-sorts the edge list by source — the exact move
+    the reference makes by launching its forward kernel with input/output
+    roles swapped (scattergather_kernel.cu:160-170)."""
+    from roc_tpu.ops.pallas.segment_sum import build_chunk_plan
+    fwd = build_chunk_plan(np.asarray(edge_src, np.int32),
+                           np.asarray(edge_dst, np.int32), num_rows)
+    order = np.argsort(edge_src, kind="stable")
+    bwd = build_chunk_plan(np.asarray(edge_dst)[order].astype(np.int32),
+                           np.asarray(edge_src)[order].astype(np.int32),
+                           table_rows)
+    return AggregatePlans(
+        fwd_obi=jnp.asarray(fwd.obi), fwd_first=jnp.asarray(fwd.first),
+        fwd_edst=jnp.asarray(fwd.edst), fwd_esrc=jnp.asarray(fwd.esrc),
+        bwd_obi=jnp.asarray(bwd.obi), bwd_first=jnp.asarray(bwd.first),
+        bwd_edst=jnp.asarray(bwd.edst), bwd_esrc=jnp.asarray(bwd.esrc))
+
+
+def pad_plans(plans: "list[AggregatePlans]") -> AggregatePlans:
+    """Stack per-shard plans to common chunk counts (shard_map needs one
+    static program).  Pad chunks are no-ops: first=0, all dsts masked (VB),
+    obi = last window so the out-block index stays non-decreasing."""
+    from roc_tpu.ops.pallas.segment_sum import EB, VB
+
+    def stack(field):
+        arrs = [getattr(p, field) for p in plans]
+        C = max(a.shape[0] for a in arrs)
+        out = []
+        for p, a in zip(plans, arrs):
+            pad_c = C - a.shape[0]
+            if pad_c:
+                if field.endswith("obi"):
+                    fill = jnp.full((pad_c,), a[-1], a.dtype)
+                elif field.endswith("first"):
+                    fill = jnp.zeros((pad_c,), a.dtype)
+                elif field.endswith("edst"):
+                    fill = jnp.full((pad_c, EB), VB, a.dtype)
+                else:  # esrc
+                    fill = jnp.zeros((pad_c, EB), a.dtype)
+                a = jnp.concatenate([a, fill], axis=0)
+            out.append(a)
+        return jnp.stack(out)
+    return AggregatePlans(*[stack(f) for f in AggregatePlans._fields])
+
+
+def _run_plan(x, obi, first, edst, esrc, num_rows, interpret):
+    from roc_tpu.ops.pallas.segment_sum import VB, _run
+    num_windows = (num_rows + VB - 1) // VB
+    # The kernel's window height (VB=8) is the fp32 sublane tile; run the
+    # kernel in fp32 regardless of activation dtype (bf16 would need a
+    # (16,128) tile and breaks the revisit/accumulate layout).
+    out = _run(x.astype(jnp.float32), obi, first, edst, esrc,
+               num_chunks=obi.shape[0], num_windows=num_windows,
+               interpret=interpret)
+    return out[:num_rows].astype(x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def scatter_gather_pallas(x, plans: AggregatePlans, num_rows: int,
+                          table_rows: int, interpret: bool = False):
+    """Sum-aggregation via the Pallas blocked-CSR kernel.
+
+    x: [table_rows, H] -> out [num_rows, H].  Differentiable w.r.t. x; the
+    VJP runs the same kernel on the transposed plan."""
+    return _run_plan(x, plans.fwd_obi, plans.fwd_first, plans.fwd_edst,
+                     plans.fwd_esrc, num_rows, interpret)
+
+
+def _sg_fwd(x, plans, num_rows, table_rows, interpret):
+    return scatter_gather_pallas(x, plans, num_rows, table_rows,
+                                 interpret), plans
+
+
+def _sg_bwd(num_rows, table_rows, interpret, plans, g):
+    gx = _run_plan(g, plans.bwd_obi, plans.bwd_first, plans.bwd_edst,
+                   plans.bwd_esrc, table_rows, interpret)
+    none_cotangents = jax.tree.map(
+        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
+    return gx, none_cotangents
+
+
+scatter_gather_pallas.defvjp(_sg_fwd, _sg_bwd)

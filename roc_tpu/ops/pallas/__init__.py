@@ -1,0 +1,3 @@
+from roc_tpu.ops.pallas.segment_sum import ChunkPlan, build_chunk_plan
+
+__all__ = ["ChunkPlan", "build_chunk_plan"]

@@ -1,0 +1,165 @@
+"""Pallas TPU kernel for CSR sum-aggregation (the reference's
+`aggre_coop_kernel`, scattergather_kernel.cu:20-76).
+
+The reference's CUDA kernel is block-cooperative: a thread block claims a
+group of consecutive vertices, prefix-sums their degrees with CUB, stages
+source rows through shared memory and atomically accumulates.  The TPU
+formulation below is the same idea mapped onto DMA + MXU instead of
+warps + atomics:
+
+  * host-side, the sorted in-edge list is cut into CHUNKS of EB edge slots,
+    each chunk owning a WINDOW of VB=8 destination rows (8 = fp32 sublane
+    tile).  A hub vertex simply occupies many consecutive chunks of the
+    same window; sparse windows get one padded chunk (so every output row
+    is visited and zeroed).  This is the static-shape analog of the CUDA
+    kernel's dynamic per-block vertex claiming;
+  * per chunk, the kernel DMA-gathers the EB source rows from the feature
+    table in HBM into VMEM (issue-all-then-wait on one DMA semaphore — the
+    hardware pipelines the row fetches), then scatters them into the
+    window with ONE (VB x EB) @ (EB x H) matmul against a one-hot
+    destination matrix built on the VPU from an iota comparison.  The MXU
+    does the scatter-add; there are no atomics and no per-edge stores;
+  * consecutive chunks sharing a window keep the output block resident in
+    VMEM (Pallas only writes it back when the window index advances, which
+    it does monotonically because the edge list is dst-sorted).
+
+Per edge this costs VB*H MACs on the MXU (VB=8: ~6% systolic utilization —
+the price of scatter-free accumulation) and one H-row DMA.  Whether it
+beats XLA's take+segment_sum depends on the gather path, so the public op
+(roc_tpu.ops.scatter_gather) keeps XLA as the default backend and this
+kernel behind `backend="pallas"`; tests pin both to the same oracle.
+
+Backward uses the same kernel on the transposed edge list (grad_x =
+A^T @ grad_out) — the reference does literally the same role swap
+(scattergather_kernel.cu:160-170).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+VB = 8       # destination window rows (fp32 sublane tile)
+EB = 256     # edge slots per chunk
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkPlan:
+    """Host-precomputed chunk schedule for one shard's CSR."""
+    num_chunks: int
+    num_windows: int         # == out rows / VB
+    obi: np.ndarray          # [C] int32 window (out-block) index, non-decreasing
+    first: np.ndarray        # [C] int32 1 iff first chunk of its window
+    esrc: np.ndarray         # [C, EB] int32 source row in the feature table
+    edst: np.ndarray         # [C, EB] int32 dst row LOCAL to the window, or
+                             #          VB (=out of range -> masked) on pads
+    out_rows: int            # num_windows * VB (>= num dst rows)
+
+
+def build_chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
+                     num_rows: int) -> ChunkPlan:
+    """Cut a dst-sorted edge list into (window, chunk) slots.
+
+    edge_src: [E] table row per edge; edge_dst: [E] sorted dst row in
+    [0, num_rows).  Works for any E including 0.  Fully vectorized — the
+    reference workloads have 1e8 edges and this runs per shard per
+    direction at startup.
+    """
+    assert edge_src.shape == edge_dst.shape
+    edge_src = np.asarray(edge_src, np.int64)
+    edge_dst = np.asarray(edge_dst, np.int64)
+    E = edge_src.shape[0]
+    assert E == 0 or np.all(np.diff(edge_dst) >= 0), "edge_dst not sorted"
+    num_windows = max((num_rows + VB - 1) // VB, 1)
+    win_of_edge = edge_dst // VB
+    win_start = np.searchsorted(win_of_edge, np.arange(num_windows), "left")
+    win_end = np.searchsorted(win_of_edge, np.arange(num_windows), "right")
+    cnt = win_end - win_start
+    nchunks = np.maximum((cnt + EB - 1) // EB, 1)  # >=1: window gets zeroed
+    C = int(nchunks.sum())
+
+    obi = np.repeat(np.arange(num_windows), nchunks)
+    chunk0 = np.cumsum(nchunks) - nchunks          # first chunk id per window
+    first = np.zeros(C, np.int32)
+    first[chunk0] = 1
+    chunk_j = np.arange(C) - chunk0[obi]           # chunk position in window
+    chunk_lo = win_start[obi] + chunk_j * EB
+    take = np.clip(win_end[obi] - chunk_lo, 0, EB)
+    pos = chunk_lo[:, None] + np.arange(EB)[None, :]
+    valid = np.arange(EB)[None, :] < take[:, None]
+    pos = np.minimum(pos, max(E - 1, 0))
+    esrc = np.where(valid, edge_src[pos] if E else 0, 0)
+    edst = np.where(valid, (edge_dst[pos] if E else 0) - obi[:, None] * VB, VB)
+    return ChunkPlan(
+        num_chunks=C, num_windows=num_windows,
+        obi=obi.astype(np.int32), first=first,
+        esrc=esrc.astype(np.int32), edst=edst.astype(np.int32),
+        out_rows=num_windows * VB)
+
+
+def _kernel(obi_ref, first_ref, edst_ref, esrc_ref, x_hbm, out_ref,
+            xbuf, sem):
+    c = pl.program_id(0)
+
+    @pl.when(first_ref[c] == 1)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # Gather the chunk's EB source rows HBM -> VMEM.  One semaphore counts
+    # all completions; the DMA engine overlaps the row fetches.
+    def issue(e, _):
+        pltpu.make_async_copy(
+            x_hbm.at[esrc_ref[0, e]], xbuf.at[e], sem).start()
+        return 0
+    jax.lax.fori_loop(0, EB, issue, 0)
+
+    def drain(e, _):
+        pltpu.make_async_copy(
+            x_hbm.at[esrc_ref[0, e]], xbuf.at[e], sem).wait()
+        return 0
+    jax.lax.fori_loop(0, EB, drain, 0)
+
+    # One-hot scatter matrix on the VPU: S[v, e] = 1 iff edge e lands on
+    # local row v (pads carry dst=VB so they never match).
+    dst = edst_ref[0, :].reshape(1, EB)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (VB, EB), 0)
+    s = (rows == dst).astype(xbuf.dtype)
+    # MXU scatter-add: (VB x EB) @ (EB x H), accumulated into the window.
+    out_ref[:] += jax.lax.dot_general(
+        s, xbuf[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(out_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("num_chunks", "num_windows", "interpret"))
+def _run(x, obi, first, edst, esrc, num_chunks: int, num_windows: int,
+         interpret: bool = False):
+    H = x.shape[-1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # obi, first
+        grid=(num_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, EB), lambda c, obi, first: (c, 0)),
+            pl.BlockSpec((1, EB), lambda c, obi, first: (c, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),   # x table stays in HBM
+        ],
+        out_specs=pl.BlockSpec((VB, H), lambda c, obi, first: (obi[c], 0)),
+        scratch_shapes=[
+            pltpu.VMEM((EB, H), x.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_windows * VB, H), x.dtype),
+        interpret=interpret,
+    )(obi, first, edst, esrc, x)
+
+

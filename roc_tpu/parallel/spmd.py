@@ -49,25 +49,37 @@ class ShardedGraphData(NamedTuple):
     edge_dst: jnp.ndarray            # [P, E] int32, ascending per shard
     in_degree: jnp.ndarray           # [P, S] float32
     send_idx: Optional[jnp.ndarray]  # [P, P, K] int32, halo mode only
+    plans: object = None             # stacked AggregatePlans ([P, ...] axes)
 
 
-def shard_graph(part: Partition, halo: Optional[HaloMaps]) -> ShardedGraphData:
+def shard_graph(part: Partition, halo: Optional[HaloMaps],
+                backend: str = "xla") -> ShardedGraphData:
     if halo is not None:
         src = halo.edge_src_local
     else:
         src = part.edge_src.astype(np.int32)
+    plans = None
+    if backend == "pallas":
+        P_, S = part.num_parts, part.shard_nodes
+        table_rows = S + P_ * halo.K if halo is not None else P_ * S
+        plans = ops.pad_plans([
+            ops.build_aggregate_plans(src[p], part.edge_dst[p], S, table_rows)
+            for p in range(P_)])
     return ShardedGraphData(
         edge_src=jnp.asarray(src, jnp.int32),
         edge_dst=jnp.asarray(part.edge_dst, jnp.int32),
         in_degree=jnp.asarray(part.in_degree, jnp.float32),
         send_idx=None if halo is None else jnp.asarray(halo.send_idx),
+        plans=plans,
     )
 
 
 def _shard_aggregate_fn(gd_block, shard_nodes: int, use_halo: bool):
     """Build the per-shard GraphCtx.aggregate closure (runs inside shard_map;
     gd_block fields already have the leading parts-axis block squeezed)."""
+    from roc_tpu.train.driver import pallas_interpret
     edge_src, edge_dst = gd_block.edge_src, gd_block.edge_dst
+    interp = pallas_interpret()
 
     def aggregate(x, aggr):
         if use_halo:
@@ -78,6 +90,10 @@ def _shard_aggregate_fn(gd_block, shard_nodes: int, use_halo: bool):
                 [x, recv.reshape(-1, x.shape[-1])], axis=0)     # [S+P*K, H]
         else:
             table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)  # [P*S, H]
+        if gd_block.plans is not None and aggr == "sum":
+            return ops.scatter_gather_pallas(table, gd_block.plans,
+                                             shard_nodes, table.shape[0],
+                                             interp)
         return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
                                   aggr)
     return aggregate
@@ -86,10 +102,7 @@ def _shard_aggregate_fn(gd_block, shard_nodes: int, use_halo: bool):
 def _squeeze_gd(gd: ShardedGraphData) -> ShardedGraphData:
     """Drop the size-1 parts-axis block dim that shard_map leaves on each
     per-device block."""
-    return ShardedGraphData(
-        edge_src=gd.edge_src[0], edge_dst=gd.edge_dst[0],
-        in_degree=gd.in_degree[0],
-        send_idx=None if gd.send_idx is None else gd.send_idx[0])
+    return jax.tree.map(lambda a: a[0], gd)
 
 
 class SpmdTrainer(BaseTrainer):
@@ -115,7 +128,7 @@ class SpmdTrainer(BaseTrainer):
         self.mask = jax.device_put(
             pad(ds.mask, fill=MASK_NONE).astype(np.int32), node_spec)
 
-        gd = shard_graph(self.part, self.halo)
+        gd = shard_graph(self.part, self.halo, self._effective_backend())
         self.gdata = jax.tree.map(  # None (no send_idx) passes through
             lambda a: jax.device_put(a, node_spec), gd)
 
@@ -125,6 +138,7 @@ class SpmdTrainer(BaseTrainer):
 
         use_halo = self.halo is not None
         optimizer = self.optimizer
+        check_vma = gd.plans is None  # pallas_call can't annotate vma yet
 
         def local_loss(params, x, labels, mask, gd_block, key):
             gctx = GraphCtx(
@@ -133,12 +147,9 @@ class SpmdTrainer(BaseTrainer):
             return model.loss(params, x, labels, mask, gctx, key=key,
                               train=True)
 
-        gd_specs = ShardedGraphData(
-            edge_src=P(PARTS_AXIS), edge_dst=P(PARTS_AXIS),
-            in_degree=P(PARTS_AXIS),
-            send_idx=None if gd.send_idx is None else P(PARTS_AXIS))
+        gd_specs = jax.tree.map(lambda a: P(PARTS_AXIS), gd)
 
-        @partial(jax.shard_map, mesh=self.mesh,
+        @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
                  in_specs=(P(), P(), P(PARTS_AXIS), P(PARTS_AXIS),
                            P(PARTS_AXIS), gd_specs, P(), P()),
                  out_specs=(P(), P(), P()))
@@ -156,7 +167,7 @@ class SpmdTrainer(BaseTrainer):
                                                    alpha)
             return new_params, new_opt, loss
 
-        @partial(jax.shard_map, mesh=self.mesh,
+        @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
                  in_specs=(P(), P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS),
                            gd_specs),
                  out_specs=P())

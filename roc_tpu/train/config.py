@@ -41,6 +41,7 @@ class Config:
     num_parts: int = 1            # total shards (== mesh size when > 1)
     model: str = "gcn"            # gcn | sage | gin
     aggr: str = "sum"
+    aggregate_backend: str = "xla"  # xla | pallas (blocked-CSR kernel)
     verbose: bool = False
     eval_every: int = 5           # reference evaluates every 5 epochs (gnn.cc:107)
     checkpoint_path: Optional[str] = None
@@ -68,6 +69,8 @@ def parse_args(argv: List[str]) -> Config:
                    default=1)
     p.add_argument("-model", default="gcn", choices=["gcn", "sage", "gin"])
     p.add_argument("-aggr", default="sum", choices=["sum", "avg", "max", "min"])
+    p.add_argument("-aggr-backend", dest="aggregate_backend", default="xla",
+                   choices=["xla", "pallas"])
     p.add_argument("-v", dest="verbose", action="store_true")
     p.add_argument("-eval-every", dest="eval_every", type=int, default=5)
     p.add_argument("-ckpt", dest="checkpoint_path", default=None)

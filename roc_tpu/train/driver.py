@@ -36,18 +36,35 @@ class DenseGraphData(NamedTuple):
     edge_src: jnp.ndarray   # [E] int32
     edge_dst: jnp.ndarray   # [E] int32, sorted
     in_degree: jnp.ndarray  # [N] float32
+    plans: object = None    # ops.AggregatePlans when backend == "pallas"
 
 
-def dense_graph_data(graph) -> DenseGraphData:
+def pallas_interpret() -> bool:
+    """The Pallas TPU kernel runs interpreted on non-TPU backends (tests,
+    CPU dev boxes)."""
+    return jax.default_backend() != "tpu"
+
+
+def dense_graph_data(graph, backend: str = "xla") -> DenseGraphData:
+    plans = None
+    if backend == "pallas":
+        plans = ops.build_aggregate_plans(
+            graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
     return DenseGraphData(
         edge_src=jnp.asarray(graph.col_idx, jnp.int32),
         edge_dst=jnp.asarray(graph.dst_idx, jnp.int32),
         in_degree=jnp.asarray(graph.in_degrees, jnp.float32),
+        plans=plans,
     )
 
 
 def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
+    interp = pallas_interpret()
+
     def aggregate(x, aggr):
+        if g.plans is not None and aggr == "sum":
+            return ops.scatter_gather_pallas(x, g.plans, num_nodes,
+                                             x.shape[0], interp)
         return ops.scatter_gather(x, g.edge_src, g.edge_dst, num_nodes, aggr)
     return GraphCtx(aggregate=aggregate, in_degree=g.in_degree)
 
@@ -73,6 +90,16 @@ class BaseTrainer:
     # and build the jitted self._train_step / self._eval_step
     def _setup(self):
         raise NotImplementedError
+
+    def _effective_backend(self) -> str:
+        """The pallas kernel only implements sum aggregation; don't pay plan
+        construction for a backend that would silently fall back."""
+        cfg = self.config
+        if cfg.aggregate_backend == "pallas" and cfg.aggr != "sum":
+            print(f"# aggregate_backend=pallas only supports -aggr sum; "
+                  f"using xla for -aggr {cfg.aggr}")
+            return "xla"
+        return cfg.aggregate_backend
 
     def _run_step(self, step_key, alpha):
         self.params, self.opt_state, loss = self._train_step(
@@ -134,7 +161,7 @@ class Trainer(BaseTrainer):
 
     def _setup(self):
         ds, model = self.dataset, self.model
-        self.gdata = dense_graph_data(ds.graph)
+        self.gdata = dense_graph_data(ds.graph, self._effective_backend())
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.labels, jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)

@@ -25,8 +25,10 @@ jax.config.update("jax_platforms", "cpu")
 try:
     from jax._src import xla_bridge
 
-    for _name in [n for n in xla_bridge._backend_factories if n != "cpu"]:
-        xla_bridge._backend_factories.pop(_name, None)
+    # Drop only the tunnel-dialing plugin; the 'tpu' factory must stay
+    # registered (pallas.tpu registers MLIR lowerings against that platform
+    # name at import) but never initializes under jax_platforms=cpu.
+    xla_bridge._backend_factories.pop("axon", None)
 except Exception:  # pragma: no cover - private API may move across versions
     pass
 

@@ -1,0 +1,136 @@
+"""Pallas blocked-CSR aggregation kernel tests (interpret mode on CPU).
+
+The XLA take+segment_sum path is the correctness oracle for the kernel
+(SURVEY.md §7.3): forward, VJP via the transposed plan, end-to-end training
+equality, and the sharded (padded-plan) variant are all pinned to it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu import ops
+from roc_tpu.graph import datasets
+from roc_tpu.graph.partition import partition_graph
+from roc_tpu.models import build_gcn
+from roc_tpu.ops.pallas.segment_sum import EB, VB, build_chunk_plan
+from roc_tpu.parallel.spmd import SpmdTrainer
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+
+def graph_and_x(seed=3, n=150, h=16):
+    ds = datasets.synthetic("t", n, 4.0, 8, 4, n_train=20, n_val=20,
+                            n_test=20, seed=seed)
+    g = ds.graph
+    x = np.random.default_rng(seed).normal(size=(g.num_nodes, h)).astype(
+        np.float32)
+    return ds, g, x
+
+
+def dense_agg(g, x):
+    out = np.zeros_like(x)
+    np.add.at(out, g.dst_idx, x[g.col_idx])
+    return out
+
+
+def test_chunk_plan_invariants():
+    _, g, _ = graph_and_x()
+    plan = build_chunk_plan(g.col_idx.astype(np.int32),
+                            g.dst_idx.astype(np.int32), g.num_nodes)
+    # windows visited in order; one 'first' per window; every window present
+    assert np.all(np.diff(plan.obi) >= 0)
+    assert plan.first[plan.obi != np.roll(plan.obi, 1)].all()
+    assert set(plan.obi.tolist()) == set(range(plan.num_windows))
+    # pad slots are masked (dst == VB) and point at row 0
+    live = plan.edst != VB
+    total_live = int(live.sum())
+    assert total_live == g.num_edges
+    assert np.all(plan.esrc[~live] == 0)
+    assert plan.esrc.shape[1] == EB
+
+
+def test_forward_matches_dense():
+    _, g, x = graph_and_x()
+    plans = ops.build_aggregate_plans(g.col_idx, g.dst_idx, g.num_nodes,
+                                      g.num_nodes)
+    out = ops.scatter_gather_pallas(jnp.asarray(x), plans, g.num_nodes,
+                                    g.num_nodes, True)
+    np.testing.assert_allclose(np.asarray(out), dense_agg(g, x), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_vjp_matches_transposed_aggregation():
+    _, g, x = graph_and_x(h=8)
+    plans = ops.build_aggregate_plans(g.col_idx, g.dst_idx, g.num_nodes,
+                                      g.num_nodes)
+    ct = np.random.default_rng(9).normal(size=x.shape).astype(np.float32)
+
+    def f(x):
+        return jnp.sum(ops.scatter_gather_pallas(
+            x, plans, g.num_nodes, g.num_nodes, True) * ct)
+    grad = jax.grad(f)(jnp.asarray(x))
+    a = np.zeros((g.num_nodes, g.num_nodes), np.float32)
+    np.add.at(a, (g.dst_idx, g.col_idx), 1.0)
+    np.testing.assert_allclose(np.asarray(grad), a.T @ ct, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_rectangular_table():
+    # table larger than out (the halo case: local rows + received rows)
+    _, g, x = graph_and_x()
+    extra = 24
+    table = np.concatenate(
+        [x, np.random.default_rng(1).normal(size=(extra, x.shape[1]))
+         .astype(np.float32)])
+    # route some edges to the extra rows
+    src = g.col_idx.astype(np.int64).copy()
+    src[::7] = g.num_nodes + (src[::7] % extra)
+    plans = ops.build_aggregate_plans(src, g.dst_idx, g.num_nodes,
+                                      table.shape[0])
+    out = ops.scatter_gather_pallas(jnp.asarray(table), plans, g.num_nodes,
+                                    table.shape[0], True)
+    expect = np.zeros_like(x)
+    np.add.at(expect, g.dst_idx, table[src])
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_training_pallas_equals_xla_single_device():
+    ds, g, _ = graph_and_x()
+    cfg_x = Config(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=3,
+                   dropout_rate=0.0, eval_every=10**9)
+    cfg_p = Config(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=3,
+                   dropout_rate=0.0, eval_every=10**9,
+                   aggregate_backend="pallas")
+    tx = Trainer(cfg_x, ds, build_gcn(cfg_x.layers, 0.0))
+    tp = Trainer(cfg_p, ds, build_gcn(cfg_p.layers, 0.0))
+    for i in range(3):
+        lx, lp = float(tx.run_epoch()), float(tp.run_epoch())
+        np.testing.assert_allclose(lp, lx, rtol=1e-4, err_msg=f"epoch {i}")
+    np.testing.assert_allclose(
+        np.asarray(tp.params["linear_0"]), np.asarray(tx.params["linear_0"]),
+        rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("halo", [False, True])
+def test_training_pallas_equals_xla_sharded(halo):
+    ds, g, _ = graph_and_x(n=220)
+    base = dict(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=2,
+                dropout_rate=0.0, eval_every=10**9, num_parts=4, halo=halo)
+    tx = SpmdTrainer(Config(**base), ds, build_gcn(base["layers"], 0.0))
+    tp = SpmdTrainer(Config(**base, aggregate_backend="pallas"), ds,
+                     build_gcn(base["layers"], 0.0))
+    for i in range(2):
+        lx, lp = float(tx.run_epoch()), float(tp.run_epoch())
+        np.testing.assert_allclose(lp, lx, rtol=1e-4, err_msg=f"epoch {i}")
+
+
+def test_empty_graph_plan():
+    plan = build_chunk_plan(np.zeros(0, np.int32), np.zeros(0, np.int32), 10)
+    assert plan.num_chunks == plan.num_windows
+    x = jnp.ones((10, 8))
+    plans = ops.build_aggregate_plans(np.zeros(0, np.int64),
+                                      np.zeros(0, np.int64), 10, 10)
+    out = ops.scatter_gather_pallas(x, plans, 10, 10, True)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros((10, 8)))
