@@ -12,7 +12,7 @@ from __future__ import annotations
 import sys
 
 from roc_tpu.graph import datasets
-from roc_tpu.models import build_gcn
+from roc_tpu.models import build_model
 from roc_tpu.train.config import parse_args
 from roc_tpu.train.driver import Trainer
 
@@ -45,19 +45,10 @@ def main(argv=None) -> int:
         print("error: one of -file or -dataset is required", file=sys.stderr)
         return 2
 
-    if cfg.model != "gcn":
-        print(f"error: model {cfg.model!r} arrives with the model zoo; "
-              "only gcn is wired into the CLI so far", file=sys.stderr)
-        return 2
-    model = build_gcn(cfg.layers, cfg.dropout_rate, cfg.aggr)
+    model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr)
 
     if cfg.num_parts > 1:
-        try:
-            from roc_tpu.parallel.spmd import SpmdTrainer
-        except ImportError:
-            print("error: the multi-shard (-parts > 1) trainer is not built "
-                  "yet; run single-shard for now", file=sys.stderr)
-            return 2
+        from roc_tpu.parallel.spmd import SpmdTrainer
         trainer = SpmdTrainer(cfg, ds, model)
     else:
         trainer = Trainer(cfg, ds, model)

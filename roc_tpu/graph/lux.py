@@ -36,14 +36,21 @@ LUX_SUFFIX = ".add_self_edge.lux"
 
 
 def read_lux(path: str) -> Csr:
-    """Read a `.lux` graph file into an exclusive-prefix CSR."""
-    with open(path, "rb") as f:
-        num_nodes = int(np.fromfile(f, dtype=np.uint32, count=1)[0])
-        num_edges = int(np.fromfile(f, dtype=np.uint64, count=1)[0])
-        raw_rows = np.fromfile(f, dtype=np.uint64, count=num_nodes)
-        assert raw_rows.shape[0] == num_nodes, "truncated .lux row section"
-        raw_cols = np.fromfile(f, dtype=np.uint32, count=num_edges)
-        assert raw_cols.shape[0] == num_edges, "truncated .lux col section"
+    """Read a `.lux` graph file into an exclusive-prefix CSR (native C++
+    reader when built, NumPy otherwise)."""
+    from roc_tpu import native
+    if native.available():
+        num_nodes, num_edges = native.lux_header(path)
+        raw_rows, raw_cols = native.lux_read_slice(
+            path, 0, num_nodes, 0, num_edges)
+    else:
+        with open(path, "rb") as f:
+            num_nodes = int(np.fromfile(f, dtype=np.uint32, count=1)[0])
+            num_edges = int(np.fromfile(f, dtype=np.uint64, count=1)[0])
+            raw_rows = np.fromfile(f, dtype=np.uint64, count=num_nodes)
+            assert raw_rows.shape[0] == num_nodes, "truncated .lux rows"
+            raw_cols = np.fromfile(f, dtype=np.uint32, count=num_edges)
+            assert raw_cols.shape[0] == num_edges, "truncated .lux cols"
     # Reference asserts monotonicity and the final offset (gnn.cc:797-800).
     assert np.all(np.diff(raw_rows.astype(np.int64)) >= 0)
     assert num_nodes == 0 or raw_rows[-1] == num_edges
@@ -72,9 +79,14 @@ def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
         assert feats.size == num_nodes * in_dim, "feats.bin size mismatch"
         return feats.reshape(num_nodes, in_dim)
     csv_path = prefix + ".feats.csv"
-    feats = np.loadtxt(csv_path, delimiter=",", dtype=np.float32, ndmin=2)
-    assert feats.shape == (num_nodes, in_dim), (
-        f"feats.csv shape {feats.shape} != ({num_nodes},{in_dim})")
+    from roc_tpu import native
+    if native.available():
+        feats = native.parse_feats_csv(csv_path, num_nodes, in_dim)
+    else:
+        feats = np.loadtxt(csv_path, delimiter=",", dtype=np.float32,
+                           ndmin=2)
+        assert feats.shape == (num_nodes, in_dim), (
+            f"feats.csv shape {feats.shape} != ({num_nodes},{in_dim})")
     feats.tofile(bin_path)
     return feats
 

@@ -56,18 +56,17 @@ def edge_balanced_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
     assert num_parts >= 1
     if g.num_nodes == 0:
         return [(0, -1)] * num_parts
-    deg = np.diff(g.row_ptr)
-    edge_cap = (g.num_edges + num_parts - 1) // num_parts
-    bounds: List[Tuple[int, int]] = []
-    left, cnt = 0, 0
-    for v in range(g.num_nodes):
-        cnt += int(deg[v])
-        if cnt > edge_cap:
-            bounds.append((left, v))
-            cnt = 0
-            left = v + 1
-    if cnt > 0 or left < g.num_nodes:
-        bounds.append((left, g.num_nodes - 1))
+    from roc_tpu import native
+    if native.available():
+        n, nb = native.partition(g.row_ptr[1:], g.num_edges, num_parts)
+        if n > num_parts:
+            # C side dropped the overflow parts; fall back to the Python
+            # scan whose full result the repair loops below can merge.
+            bounds = _python_bounds(g, num_parts)
+        else:
+            bounds = [tuple(b) for b in nb[:n]]
+    else:
+        bounds = _python_bounds(g, num_parts)
     # Repair (reference would assert instead):
     while len(bounds) > num_parts:  # merge the two lightest neighbors
         w = [int(g.row_ptr[hi + 1] - g.row_ptr[lo]) for lo, hi in bounds]
@@ -84,6 +83,23 @@ def edge_balanced_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
         mid = (lo + hi) // 2
         bounds[i] = (lo, mid)
         bounds.insert(i + 1, (mid + 1, hi))
+    return bounds
+
+
+def _python_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
+    """Pure-NumPy greedy cut (oracle for the native implementation)."""
+    deg = np.diff(g.row_ptr)
+    edge_cap = (g.num_edges + num_parts - 1) // num_parts
+    bounds: List[Tuple[int, int]] = []
+    left, cnt = 0, 0
+    for v in range(g.num_nodes):
+        cnt += int(deg[v])
+        if cnt > edge_cap:
+            bounds.append((left, v))
+            cnt = 0
+            left = v + 1
+    if cnt > 0 or left < g.num_nodes:
+        bounds.append((left, g.num_nodes - 1))
     return bounds
 
 

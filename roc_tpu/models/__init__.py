@@ -1,4 +1,26 @@
 from roc_tpu.models.model import GraphCtx, Model
 from roc_tpu.models.gcn import build_gcn
+from roc_tpu.models.sage import build_sage
+from roc_tpu.models.gin import build_gin
 
-__all__ = ["Model", "GraphCtx", "build_gcn"]
+
+def build_model(name: str, layers, dropout_rate: float = 0.5,
+                aggr: str = "") -> Model:
+    """Model registry keyed by the CLI's -model flag.
+
+    aggr="" means "the model's own default" (gcn: sum — the reference's only
+    wired AggrType; sage: avg; gin: sum, where a non-sum choice is rejected
+    because the GIN update is defined on sums)."""
+    if name == "gcn":
+        return build_gcn(layers, dropout_rate, aggr or "sum")
+    if name == "sage":
+        return build_sage(layers, dropout_rate, aggr or "avg")
+    if name == "gin":
+        if aggr not in ("", "sum"):
+            raise ValueError("gin is defined on sum aggregation")
+        return build_gin(layers, dropout_rate)
+    raise ValueError(f"unknown model {name!r} (gcn|sage|gin)")
+
+
+__all__ = ["Model", "GraphCtx", "build_gcn", "build_sage", "build_gin",
+           "build_model"]

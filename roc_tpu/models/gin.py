@@ -1,0 +1,38 @@
+"""GIN + degree normalization (BASELINE.json config #4: "deep aggregation,
+halo-heavy").
+
+GIN-0 update (eps = 0) in the reference's op vocabulary:
+
+    t   = dropout(t)
+    t   = sum_{u in N(v) ∪ {v}} t[u]  # scatter_gather, AGGR_SUM — the input
+                                      # contract guarantees self-edges
+                                      # (.add_self_edge.lux), so this IS
+                                      # (1+eps)·x_v + Σ_neighbors with eps=0;
+                                      # no extra self term is added
+    t   = MLP(t) = W2·relu(W1·t)
+    t   = t / sqrt(in_degree)         # the reference's InDegreeNorm as the
+                                      # GraphNorm stage (graphnorm_kernel.cu)
+    (+ ReLU except on the output layer)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from roc_tpu.models.model import Model
+
+
+def build_gin(layers: Sequence[int], dropout_rate: float = 0.5) -> Model:
+    assert len(layers) >= 2
+    model = Model(in_dim=layers[0])
+    t = model.input
+    for i in range(1, len(layers)):
+        t = model.dropout(t, dropout_rate)
+        t = model.scatter_gather(t, "sum")   # self-edge supplies the +x_v
+        t = model.linear(t, layers[i], activation="relu")   # MLP hidden
+        t = model.linear(t, layers[i])                      # MLP out
+        t = model.indegree_norm(t)
+        if i != len(layers) - 1:
+            t = model.relu(t)
+    model.softmax_cross_entropy(t)
+    return model

@@ -1,0 +1,38 @@
+"""GraphSAGE-mean (BASELINE.json config #3: "exercises scatter-gather
+variants").
+
+The reference enumerates AGGR_AVG in its AggrType (gnn.h:77-81) but only
+ever wires AGGR_SUM into the built-in GCN; this model exercises the mean
+path.  Per layer:
+
+    t      = dropout(t)
+    self_  = W_self · t
+    neigh  = W_neigh · mean_{u in N(v)} t[u]
+    t      = self_ + neigh            (+ ReLU except on the output layer)
+
+(the standard SAGE-mean update, expressed entirely in the reference's op
+vocabulary: linear / scatter_gather / add / relu.)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from roc_tpu.models.model import Model
+
+
+def build_sage(layers: Sequence[int], dropout_rate: float = 0.5,
+               aggr: str = "avg") -> Model:
+    assert len(layers) >= 2
+    model = Model(in_dim=layers[0])
+    t = model.input
+    for i in range(1, len(layers)):
+        t = model.dropout(t, dropout_rate)
+        self_ = model.linear(t, layers[i])
+        neigh = model.scatter_gather(t, aggr)
+        neigh = model.linear(neigh, layers[i])
+        t = model.add(self_, neigh)
+        if i != len(layers) - 1:
+            t = model.relu(t)
+    model.softmax_cross_entropy(t)
+    return model

@@ -1,0 +1,143 @@
+"""ctypes bindings for the native runtime library (see src/roc_native.cc).
+
+Auto-builds `libroc_native.so` with the in-tree Makefile on first use (g++,
+no external deps); every entry point has a NumPy fallback in the pure-Python
+modules, so a missing toolchain degrades to the slow path, never to an
+error.  `ROC_TPU_NO_NATIVE=1` disables the native path entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libroc_native.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried or os.environ.get("ROC_TPU_NO_NATIVE") == "1":
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        L = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    L.roc_lux_header.argtypes = [ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_uint32),
+                                 ctypes.POINTER(ctypes.c_uint64)]
+    L.roc_lux_header.restype = ctypes.c_int
+    L.roc_lux_read_slice.argtypes = [ctypes.c_char_p] + \
+        [ctypes.c_uint64] * 4 + [u64p, u32p]
+    L.roc_lux_read_slice.restype = ctypes.c_int
+    L.roc_lux_write.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                ctypes.c_uint64, u64p, u32p]
+    L.roc_lux_write.restype = ctypes.c_int
+    L.roc_partition.argtypes = [u64p, ctypes.c_uint64, ctypes.c_uint64,
+                                ctypes.c_int64, i64p]
+    L.roc_partition.restype = ctypes.c_int64
+    L.roc_parse_feats_csv.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.c_int64, f32p]
+    L.roc_parse_feats_csv.restype = ctypes.c_int64
+    L.roc_in_degrees.argtypes = [u64p, ctypes.c_uint64, f32p]
+    L.roc_in_degrees.restype = None
+    _lib = L
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# -- typed wrappers ---------------------------------------------------------
+
+def lux_header(path: str):
+    L = lib()
+    assert L is not None
+    nv, ne = ctypes.c_uint32(), ctypes.c_uint64()
+    rc = L.roc_lux_header(path.encode(), ctypes.byref(nv), ctypes.byref(ne))
+    if rc != 0:
+        raise IOError(f"roc_lux_header({path}) failed rc={rc}")
+    return int(nv.value), int(ne.value)
+
+
+def lux_read_slice(path: str, row_lo: int, row_hi: int, col_lo: int,
+                   col_hi: int):
+    """Rows [row_lo,row_hi) of the offset section + cols [col_lo,col_hi)."""
+    L = lib()
+    assert L is not None
+    rows = np.empty(row_hi - row_lo, np.uint64)
+    cols = np.empty(col_hi - col_lo, np.uint32)
+    rc = L.roc_lux_read_slice(path.encode(), row_lo, row_hi, col_lo, col_hi,
+                              rows, cols)
+    if rc != 0:
+        raise IOError(f"roc_lux_read_slice({path}) failed rc={rc}")
+    return rows, cols
+
+
+def lux_write(path: str, raw_rows: np.ndarray, raw_cols: np.ndarray):
+    L = lib()
+    assert L is not None
+    raw_rows = np.ascontiguousarray(raw_rows, np.uint64)
+    raw_cols = np.ascontiguousarray(raw_cols, np.uint32)
+    rc = L.roc_lux_write(path.encode(), len(raw_rows), len(raw_cols),
+                         raw_rows, raw_cols)
+    if rc != 0:
+        raise IOError(f"roc_lux_write({path}) failed rc={rc}")
+
+
+def partition(raw_rows: np.ndarray, num_edges: int, num_parts: int):
+    """Greedy edge-balanced bounds; returns (nproduced, bounds [P,2])."""
+    L = lib()
+    assert L is not None
+    raw_rows = np.ascontiguousarray(raw_rows, np.uint64)
+    bounds = np.zeros((num_parts, 2), np.int64)
+    n = L.roc_partition(raw_rows, len(raw_rows), num_edges, num_parts,
+                        bounds.reshape(-1))
+    return int(n), bounds
+
+
+def parse_feats_csv(path: str, num_rows: int, num_cols: int) -> np.ndarray:
+    L = lib()
+    assert L is not None
+    out = np.empty((num_rows, num_cols), np.float32)
+    n = L.roc_parse_feats_csv(path.encode(), num_rows, num_cols,
+                              out.reshape(-1))
+    if n != num_rows:
+        raise IOError(f"roc_parse_feats_csv({path}): parsed {n} rows, "
+                      f"expected {num_rows}")
+    return out
+
+
+def in_degrees(raw_rows: np.ndarray) -> np.ndarray:
+    L = lib()
+    assert L is not None
+    raw_rows = np.ascontiguousarray(raw_rows, np.uint64)
+    out = np.empty(len(raw_rows), np.float32)
+    L.roc_in_degrees(raw_rows, len(raw_rows), out)
+    return out

@@ -1,0 +1,174 @@
+// Native runtime layer: graph IO + partitioning hot paths.
+//
+// The reference implements its whole data layer natively (load_task.cu:
+// per-partition fseeko/fread of the .lux byte ranges; gnn.cc:751-872 header
+// read + greedy edge-balanced partition; load_task.cu:25-74 feature CSV
+// parse with .feats.bin caching).  This library is the TPU framework's
+// equivalent: the byte-level parsing/seeking/partitioning runs in C++, and
+// Python (roc_tpu.graph.lux / .partition) calls it through ctypes, with a
+// NumPy fallback that doubles as the correctness oracle in tests.
+//
+// Build: make -C roc_tpu/native    (g++ -O3 -shared; no external deps)
+// ABI: plain C symbols; all buffers are caller-allocated NumPy arrays.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// .lux layout (see roc_tpu/graph/lux.py): u32 numNodes, u64 numEdges,
+// u64 raw_rows[numNodes] (inclusive end offsets), u32 raw_cols[numEdges].
+static const long HEADER_SIZE = 12;  // sizeof(u32) + sizeof(u64)
+
+// Returns 0 on success; fills *num_nodes / *num_edges.
+int roc_lux_header(const char* path, uint32_t* num_nodes,
+                   uint64_t* num_edges) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  int ok = fread(num_nodes, sizeof(uint32_t), 1, f) == 1 &&
+           fread(num_edges, sizeof(uint64_t), 1, f) == 1;
+  fclose(f);
+  return ok ? 0 : -2;
+}
+
+// Read a vertex/edge slice: rows [row_lo, row_hi) of the offset section and
+// cols [col_lo, col_hi) of the column section — the per-partition seeking
+// pattern of the reference's load_graph_impl.  Whole-graph read = one slice.
+int roc_lux_read_slice(const char* path, uint64_t row_lo, uint64_t row_hi,
+                       uint64_t col_lo, uint64_t col_hi,
+                       uint64_t* rows_out, uint32_t* cols_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint32_t nv;
+  uint64_t ne;
+  if (fread(&nv, sizeof nv, 1, f) != 1 || fread(&ne, sizeof ne, 1, f) != 1) {
+    fclose(f);
+    return -2;
+  }
+  if (row_hi > nv || col_hi > ne || row_lo > row_hi || col_lo > col_hi) {
+    fclose(f);
+    return -3;
+  }
+  int rc = 0;
+  uint64_t nrows = row_hi - row_lo, ncols = col_hi - col_lo;
+  if (nrows) {
+    if (fseeko(f, HEADER_SIZE + 8 * (long)row_lo, SEEK_SET) != 0 ||
+        fread(rows_out, 8, nrows, f) != nrows)
+      rc = -4;
+  }
+  if (rc == 0 && ncols) {
+    if (fseeko(f, HEADER_SIZE + 8 * (long)nv + 4 * (long)col_lo,
+               SEEK_SET) != 0 ||
+        fread(cols_out, 4, ncols, f) != ncols)
+      rc = -5;
+  }
+  fclose(f);
+  return rc;
+}
+
+int roc_lux_write(const char* path, uint32_t num_nodes, uint64_t num_edges,
+                  const uint64_t* raw_rows, const uint32_t* raw_cols) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  int ok = fwrite(&num_nodes, sizeof num_nodes, 1, f) == 1 &&
+           fwrite(&num_edges, sizeof num_edges, 1, f) == 1 &&
+           fwrite(raw_rows, 8, num_nodes, f) == num_nodes &&
+           fwrite(raw_cols, 4, num_edges, f) == num_edges;
+  fclose(f);
+  return ok ? 0 : -2;
+}
+
+// Greedy edge-balanced contiguous partition — the exact cut rule of the
+// reference (gnn.cc:806-829): accumulate in-degrees, open a new part when
+// the running count exceeds ceil(E/P).  raw_rows are inclusive end offsets
+// (the on-disk form).  bounds_out: [num_parts][2] inclusive vertex ranges.
+// Returns the number of parts actually produced (may differ from
+// num_parts for pathological graphs; Python repairs, as the reference
+// would have assert-failed).
+int64_t roc_partition(const uint64_t* raw_rows, uint64_t num_nodes,
+                      uint64_t num_edges, int64_t num_parts,
+                      int64_t* bounds_out) {
+  if (num_parts < 1 || num_nodes == 0) return 0;
+  uint64_t edge_cap = (num_edges + num_parts - 1) / num_parts;
+  uint64_t cnt = 0, left = 0;
+  int64_t p = 0;
+  for (uint64_t v = 0; v < num_nodes; v++) {
+    cnt += raw_rows[v] - (v ? raw_rows[v - 1] : 0);
+    if (cnt > edge_cap) {
+      if (p < num_parts) {
+        bounds_out[2 * p] = (int64_t)left;
+        bounds_out[2 * p + 1] = (int64_t)v;
+      }
+      p++;
+      cnt = 0;
+      left = v + 1;
+    }
+  }
+  if (cnt > 0 || left < num_nodes) {
+    if (p < num_parts) {
+      bounds_out[2 * p] = (int64_t)left;
+      bounds_out[2 * p + 1] = (int64_t)num_nodes - 1;
+    }
+    p++;
+  }
+  return p;
+}
+
+// Fast CSV float parse: num_rows lines of num_cols comma-separated floats
+// (the reference's cold-start path before it writes .feats.bin,
+// load_task.cu:44-66).  Returns rows parsed, or negative errno-style code.
+int64_t roc_parse_feats_csv(const char* path, int64_t num_rows,
+                            int64_t num_cols, float* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  // Stream with a buffered reader; strtof consumes "+1.5e-3" etc. and
+  // leaves the pointer on the delimiter.
+  size_t cap = 1 << 20;
+  char* line = (char*)malloc(cap);
+  int64_t r = 0;
+  for (; r < num_rows; r++) {
+    ssize_t len = getline(&line, &cap, f);
+    if (len < 0) break;
+    char* p = line;
+    for (int64_t c = 0; c < num_cols; c++) {
+      char* end;
+      out[r * num_cols + c] = strtof(p, &end);
+      if (end == p) {  // malformed/empty cell — match NumPy-path strictness
+        free(line);
+        fclose(f);
+        return -(r + 2);
+      }
+      p = end;
+      if (c + 1 < num_cols) {
+        if (*p != ',') {  // exactly one delimiter; too few columns errors
+          free(line);
+          fclose(f);
+          return -(r + 2);
+        }
+        p++;
+      }
+    }
+    while (*p == ' ' || *p == '\r') p++;
+    if (*p != '\n' && *p != '\0') {  // trailing junk / extra columns
+      free(line);
+      fclose(f);
+      return -(r + 2);
+    }
+  }
+  free(line);
+  fclose(f);
+  return r;
+}
+
+// In-degree computation from inclusive end offsets (device CSR build prep;
+// the reference does this on-GPU in init_graph_kernel, load_task.cu:271-294
+// — on TPU the degree vector is a host-side precompute).
+void roc_in_degrees(const uint64_t* raw_rows, uint64_t num_nodes,
+                    float* deg_out) {
+  for (uint64_t v = 0; v < num_nodes; v++)
+    deg_out[v] = (float)(raw_rows[v] - (v ? raw_rows[v - 1] : 0));
+}
+
+}  // extern "C"

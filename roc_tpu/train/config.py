@@ -40,7 +40,7 @@ class Config:
     seed: int = 1
     num_parts: int = 1            # total shards (== mesh size when > 1)
     model: str = "gcn"            # gcn | sage | gin
-    aggr: str = "sum"
+    aggr: str = ""                # "" = model default; sum|avg|max|min
     aggregate_backend: str = "xla"  # xla | pallas (blocked-CSR kernel)
     verbose: bool = False
     eval_every: int = 5           # reference evaluates every 5 epochs (gnn.cc:107)
@@ -68,7 +68,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-parts", "-ng", "-ll:gpu", dest="num_parts", type=int,
                    default=1)
     p.add_argument("-model", default="gcn", choices=["gcn", "sage", "gin"])
-    p.add_argument("-aggr", default="sum", choices=["sum", "avg", "max", "min"])
+    p.add_argument("-aggr", default="",
+                   choices=["", "sum", "avg", "max", "min"])
     p.add_argument("-aggr-backend", dest="aggregate_backend", default="xla",
                    choices=["xla", "pallas"])
     p.add_argument("-v", dest="verbose", action="store_true")

@@ -93,11 +93,13 @@ class BaseTrainer:
 
     def _effective_backend(self) -> str:
         """The pallas kernel only implements sum aggregation; don't pay plan
-        construction for a backend that would silently fall back."""
+        construction when the built model contains no sum-aggregate op."""
         cfg = self.config
-        if cfg.aggregate_backend == "pallas" and cfg.aggr != "sum":
-            print(f"# aggregate_backend=pallas only supports -aggr sum; "
-                  f"using xla for -aggr {cfg.aggr}")
+        aggrs = {op.attrs["aggr"] for op in self.model.ops
+                 if op.kind == "aggregate"}
+        if cfg.aggregate_backend == "pallas" and "sum" not in aggrs:
+            print(f"# aggregate_backend=pallas only accelerates sum "
+                  f"aggregation; this model uses {sorted(aggrs)} — using xla")
             return "xla"
         return cfg.aggregate_backend
 
