@@ -19,6 +19,13 @@ from roc_tpu.train.driver import Trainer
 
 def main(argv=None) -> int:
     cfg = parse_args(sys.argv[1:] if argv is None else argv)
+    if cfg.multihost:
+        # DCN path: each host contributes its local devices to one global
+        # mesh (the analog of the reference's Legion/GASNet multi-machine
+        # launch, Makefile:26).  Coordinator/process env comes from the
+        # cluster (GKE/TPU-VM auto-detection inside initialize()).
+        import jax
+        jax.distributed.initialize()
     if not cfg.layers:
         print("error: -layers is required (e.g. -layers 1433-16-7)",
               file=sys.stderr)

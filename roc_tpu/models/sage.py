@@ -7,11 +7,14 @@ path.  Per layer:
 
     t      = dropout(t)
     self_  = W_self · t
-    neigh  = W_neigh · mean_{u in N(v)} t[u]
+    neigh  = W_neigh · mean_{u in N(v) ∪ {v}} t[u]
     t      = self_ + neigh            (+ ReLU except on the output layer)
 
-(the standard SAGE-mean update, expressed entirely in the reference's op
-vocabulary: linear / scatter_gather / add / relu.)
+(expressed entirely in the reference's op vocabulary: linear /
+scatter_gather / add / relu.  The input contract guarantees self-edges
+(.add_self_edge.lux), so the mean includes the vertex itself — the
+GraphSAGE-mean "mean over neighborhood including self" convention from the
+original paper's Algorithm 1 variant, not the self-excluded mean.)
 """
 
 from __future__ import annotations

@@ -38,7 +38,10 @@ def lib() -> Optional[ctypes.CDLL]:
     if _tried or os.environ.get("ROC_TPU_NO_NATIVE") == "1":
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and not _build():
+    # Always run make: it is a no-op when the .so is newer than the source
+    # and rebuilds after a source change (a stale library would otherwise
+    # load silently).
+    if not _build() and not os.path.exists(_SO):
         return None
     try:
         L = ctypes.CDLL(_SO)

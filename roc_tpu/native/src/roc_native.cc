@@ -157,6 +157,20 @@ int64_t roc_parse_feats_csv(const char* path, int64_t num_rows,
       return -(r + 2);
     }
   }
+  // Match the NumPy path's strictness on row count too: anything but
+  // trailing blank lines after num_rows rows is an error.
+  if (r == num_rows) {
+    ssize_t len;
+    while ((len = getline(&line, &cap, f)) >= 0) {
+      char* p = line;
+      while (*p == ' ' || *p == '\r' || *p == '\n') p++;
+      if (*p != '\0') {
+        free(line);
+        fclose(f);
+        return -(num_rows + 2);
+      }
+    }
+  }
   free(line);
   fclose(f);
   return r;

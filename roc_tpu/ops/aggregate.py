@@ -25,6 +25,43 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Above this many elements in the gathered [E, H] intermediate, sum
+# aggregation switches to an edge-chunked scan with in-place accumulation
+# (bounded memory).  2^28 elems = 1 GiB fp32.
+_CHUNK_THRESHOLD_ELEMS = 1 << 28
+_CHUNK_TARGET_ELEMS = 1 << 25      # ~128 MiB fp32 per chunk
+
+
+def _chunked_segment_sum(x, edge_src, edge_dst, num_nodes: int):
+    """Memory-bounded sum aggregation: scan over edge chunks, scatter-adding
+    into a donated accumulator.
+
+    XLA materializes jnp.take's [E, H] result before segment_sum; at
+    reference scale (reddit: 2.3e7 edges x 256 features x 4 B = 24 GB) that
+    alone overflows a chip's HBM.  The reference never faces this because
+    each GPU task only touches its partition's edge slice and stages rows
+    through a fixed framebuffer cache (load_task.cu:365-374) — this scan is
+    the single-chip analog: fixed [chunk, H] working set, out + one chunk
+    in flight.  Pad edges route to an extra throwaway row (num_nodes).
+    """
+    E, H = edge_src.shape[0], x.shape[1]
+    chunk = max(_CHUNK_TARGET_ELEMS // max(H, 1), 1024)
+    nchunks = -(-E // chunk)
+    pad = nchunks * chunk - E
+    src = jnp.pad(edge_src, (0, pad))                      # row 0: harmless
+    dst = jnp.pad(edge_dst, (0, pad), constant_values=num_nodes)
+    acc = jnp.zeros((num_nodes + 1, H), x.dtype)
+
+    def body(acc, sl):
+        s, d = sl
+        return acc.at[d].add(jnp.take(x, s, axis=0),
+                             indices_are_sorted=True,
+                             mode="promise_in_bounds"), None
+    acc, _ = jax.lax.scan(
+        body, acc, (src.reshape(nchunks, chunk), dst.reshape(nchunks, chunk)))
+    return acc[:num_nodes]
+
+
 def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
     """out[v] = aggr over in-edges of x[src].
 
@@ -36,6 +73,9 @@ def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
       num_nodes: number of output rows (static).
       aggr: one of sum/avg/max/min.
     """
+    if (aggr == "sum"
+            and edge_src.shape[0] * x.shape[1] > _CHUNK_THRESHOLD_ELEMS):
+        return _chunked_segment_sum(x, edge_src, edge_dst, num_nodes)
     gathered = jnp.take(x, edge_src, axis=0)
     if aggr == "sum":
         return jax.ops.segment_sum(gathered, edge_dst, num_segments=num_nodes,

@@ -49,6 +49,8 @@ class Config:
     resume: bool = False
     use_bf16: bool = False        # opt-in activation bf16 (SURVEY §7 non-goal note)
     halo: bool = True             # v1 halo exchange vs v0 all_gather
+    profile_dir: str = ""         # write a jax.profiler trace of epochs 3-5
+    multihost: bool = False       # jax.distributed.initialize() before run
 
 
 def parse_args(argv: List[str]) -> Config:
@@ -79,6 +81,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-resume", action="store_true")
     p.add_argument("-bf16", dest="use_bf16", action="store_true")
     p.add_argument("-no-halo", dest="halo", action="store_false")
+    p.add_argument("-profile", dest="profile_dir", default="")
+    p.add_argument("-multihost", action="store_true")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

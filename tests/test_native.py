@@ -95,6 +95,16 @@ def test_csv_parse_rejects_malformed(built, tmp_path):
     p.write_text("1,2,3\n")
     with pytest.raises(IOError):
         built.parse_feats_csv(str(p), 2, 3)
+    # extra rows error too (NumPy's shape assert catches this case)
+    p = tmp_path / "long.csv"
+    p.write_text("1,2,3\n4,5,6\n7,8,9\n")
+    with pytest.raises(IOError):
+        built.parse_feats_csv(str(p), 2, 3)
+    # ...but trailing blank lines are fine
+    p = tmp_path / "blank.csv"
+    p.write_text("1,2,3\n4,5,6\n\n")
+    out = built.parse_feats_csv(str(p), 2, 3)
+    np.testing.assert_allclose(out, [[1, 2, 3], [4, 5, 6]])
 
 
 def test_in_degrees(built, ds):
