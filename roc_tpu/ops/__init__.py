@@ -1,6 +1,6 @@
 from roc_tpu.ops.aggregate import (
     AggregatePlans, build_aggregate_plans, pad_plans, scatter_gather,
-    scatter_gather_pallas)
+    scatter_gather_matmul, scatter_gather_pallas)
 from roc_tpu.ops.norm import indegree_norm
 from roc_tpu.ops.linear import linear
 from roc_tpu.ops.activation import apply_activation, relu, sigmoid
@@ -11,7 +11,8 @@ from roc_tpu.ops.softmax import (
 from roc_tpu.ops.init import glorot_uniform
 
 __all__ = [
-    "scatter_gather", "indegree_norm", "linear", "relu", "sigmoid",
+    "scatter_gather", "scatter_gather_matmul", "scatter_gather_pallas",
+    "indegree_norm", "linear", "relu", "sigmoid",
     "apply_activation", "add",
     "mul", "dropout", "PerfMetrics", "masked_softmax_cross_entropy",
     "perf_metrics", "glorot_uniform",

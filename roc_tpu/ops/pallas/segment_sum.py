@@ -47,6 +47,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 VB = 8       # destination window rows (fp32 sublane tile)
 EB = 256     # edge slots per chunk
+CPAD = 8     # chunk-count padding: edst is blocked (CPAD, EB) in VMEM
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +61,24 @@ class ChunkPlan:
     edst: np.ndarray         # [C, EB] int32 dst row LOCAL to the window, or
                              #          VB (=out of range -> masked) on pads
     out_rows: int            # num_windows * VB (>= num dst rows)
+
+
+def pad_chunks(obi, first, edst, esrc, pad_c: int, xp=np):
+    """Append ``pad_c`` no-op chunks to a chunk schedule (the ONE place that
+    knows the no-op recipe: re-accumulate zero into the last window —
+    first=0, every edge slot masked to VB, sources parked on row 0).
+
+    ``xp`` is numpy (host plan build) or jax.numpy (jit-time padding); both
+    share this helper so the pad invariants cannot drift apart."""
+    if pad_c == 0:
+        return obi, first, edst, esrc
+    eb = edst.shape[1]
+    last = obi[-1] if obi.shape[0] else xp.zeros((), obi.dtype)
+    obi = xp.concatenate([obi, xp.broadcast_to(last, (pad_c,)).astype(obi.dtype)])
+    first = xp.concatenate([first, xp.zeros(pad_c, first.dtype)])
+    edst = xp.concatenate([edst, xp.full((pad_c, eb), VB, edst.dtype)])
+    esrc = xp.concatenate([esrc, xp.zeros((pad_c, eb), esrc.dtype)])
+    return obi, first, edst, esrc
 
 
 def build_chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
@@ -96,6 +115,12 @@ def build_chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     pos = np.minimum(pos, max(E - 1, 0))
     esrc = np.where(valid, edge_src[pos] if E else 0, 0)
     edst = np.where(valid, (edge_dst[pos] if E else 0) - obi[:, None] * VB, VB)
+    # Pad the chunk count to a multiple of CPAD: the kernel reads edst in
+    # (CPAD, EB) blocks (Mosaic needs the sublane dim of a VMEM block to be a
+    # multiple of 8).
+    obi, first, edst, esrc = pad_chunks(obi, first, edst, esrc,
+                                        -C % CPAD, np)
+    C = obi.shape[0]
     return ChunkPlan(
         num_chunks=C, num_windows=num_windows,
         obi=obi.astype(np.int32), first=first,
@@ -112,28 +137,40 @@ def _kernel(obi_ref, first_ref, edst_ref, esrc_ref, x_hbm, out_ref,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     # Gather the chunk's EB source rows HBM -> VMEM.  One semaphore counts
-    # all completions; the DMA engine overlaps the row fetches.
+    # all completions; the DMA engine overlaps the row fetches.  esrc rides
+    # in (CPAD, EB) SMEM blocks; this chunk's addresses are row c % CPAD.
+    cm = c % CPAD
+
     def issue(e, _):
         pltpu.make_async_copy(
-            x_hbm.at[esrc_ref[0, e]], xbuf.at[e], sem).start()
+            x_hbm.at[esrc_ref[cm, e]], xbuf.at[e], sem).start()
         return 0
     jax.lax.fori_loop(0, EB, issue, 0)
 
     def drain(e, _):
         pltpu.make_async_copy(
-            x_hbm.at[esrc_ref[0, e]], xbuf.at[e], sem).wait()
+            x_hbm.at[esrc_ref[cm, e]], xbuf.at[e], sem).wait()
         return 0
     jax.lax.fori_loop(0, EB, drain, 0)
 
+    # Select this chunk's row of the (CPAD, EB) edst block with a masked
+    # sublane reduce (dynamic sublane slicing is not reliably lowerable;
+    # a compare + where + sum always is).
+    sub = jax.lax.broadcasted_iota(jnp.int32, (CPAD, EB), 0)
+    sel = sub == (c % CPAD)
+    dst = jnp.sum(jnp.where(sel, edst_ref[:], 0), axis=0,
+                  keepdims=True)                                 # [1, EB]
     # One-hot scatter matrix on the VPU: S[v, e] = 1 iff edge e lands on
     # local row v (pads carry dst=VB so they never match).
-    dst = edst_ref[0, :].reshape(1, EB)
     rows = jax.lax.broadcasted_iota(jnp.int32, (VB, EB), 0)
     s = (rows == dst).astype(xbuf.dtype)
     # MXU scatter-add: (VB x EB) @ (EB x H), accumulated into the window.
+    # HIGHEST precision: the default single-pass bf16 MXU mode would round
+    # the gathered fp32 features (the reference accumulates in fp32).
     out_ref[:] += jax.lax.dot_general(
         s, xbuf[:], dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(out_ref.dtype)
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST).astype(out_ref.dtype)
 
 
 @partial(jax.jit, static_argnames=("num_chunks", "num_windows", "interpret"))
@@ -144,8 +181,10 @@ def _run(x, obi, first, edst, esrc, num_chunks: int, num_windows: int,
         num_scalar_prefetch=2,          # obi, first
         grid=(num_chunks,),
         in_specs=[
-            pl.BlockSpec((1, EB), lambda c, obi, first: (c, 0)),
-            pl.BlockSpec((1, EB), lambda c, obi, first: (c, 0),
+            # edst rides in VMEM as (CPAD, EB) blocks (sublane-tile legal);
+            # the kernel selects row c % CPAD.
+            pl.BlockSpec((CPAD, EB), lambda c, obi, first: (c // CPAD, 0)),
+            pl.BlockSpec((CPAD, EB), lambda c, obi, first: (c // CPAD, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),   # x table stays in HBM
         ],

@@ -25,8 +25,9 @@ ICI within a slice and DCN across slices.
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,14 +43,23 @@ from roc_tpu.parallel.mesh import PARTS_AXIS, make_mesh
 from roc_tpu.train.driver import BaseTrainer
 
 
-class ShardedGraphData(NamedTuple):
-    """Per-shard edge arrays, leading axis = 'parts' (sharded)."""
+@dataclasses.dataclass
+class ShardedGraphData:
+    """Per-shard edge arrays, leading axis = 'parts' (sharded).  ``backend``
+    is pytree metadata (static)."""
     edge_src: jnp.ndarray            # [P, E] int32 (table-local for halo,
                                      #              padded-global for v0)
     edge_dst: jnp.ndarray            # [P, E] int32, ascending per shard
     in_degree: jnp.ndarray           # [P, S] float32
     send_idx: Optional[jnp.ndarray]  # [P, P, K] int32, halo mode only
     plans: object = None             # stacked AggregatePlans ([P, ...] axes)
+    backend: str = dataclasses.field(default="xla", metadata={"static": True})
+
+
+jax.tree_util.register_dataclass(
+    ShardedGraphData,
+    data_fields=["edge_src", "edge_dst", "in_degree", "send_idx", "plans"],
+    meta_fields=["backend"])
 
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
@@ -59,7 +69,7 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
     else:
         src = part.edge_src.astype(np.int32)
     plans = None
-    if backend == "pallas":
+    if backend in ("pallas", "matmul"):
         P_, S = part.num_parts, part.shard_nodes
         table_rows = S + P_ * halo.K if halo is not None else P_ * S
         plans = ops.pad_plans([
@@ -71,6 +81,7 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         in_degree=jnp.asarray(part.in_degree, jnp.float32),
         send_idx=None if halo is None else jnp.asarray(halo.send_idx),
         plans=plans,
+        backend=backend,
     )
 
 
@@ -91,9 +102,12 @@ def _shard_aggregate_fn(gd_block, shard_nodes: int, use_halo: bool):
         else:
             table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)  # [P*S, H]
         if gd_block.plans is not None and aggr == "sum":
-            return ops.scatter_gather_pallas(table, gd_block.plans,
-                                             shard_nodes, table.shape[0],
-                                             interp)
+            if gd_block.backend == "pallas":
+                return ops.scatter_gather_pallas(table, gd_block.plans,
+                                                 shard_nodes, table.shape[0],
+                                                 interp)
+            return ops.scatter_gather_matmul(table, gd_block.plans,
+                                             shard_nodes, table.shape[0])
         return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
                                   aggr)
     return aggregate
@@ -128,7 +142,8 @@ class SpmdTrainer(BaseTrainer):
         self.mask = jax.device_put(
             pad(ds.mask, fill=MASK_NONE).astype(np.int32), node_spec)
 
-        gd = shard_graph(self.part, self.halo, self._effective_backend())
+        backend = self._effective_backend()
+        gd = shard_graph(self.part, self.halo, backend)
         self.gdata = jax.tree.map(  # None (no send_idx) passes through
             lambda a: jax.device_put(a, node_spec), gd)
 
@@ -138,7 +153,8 @@ class SpmdTrainer(BaseTrainer):
 
         use_halo = self.halo is not None
         optimizer = self.optimizer
-        check_vma = gd.plans is None  # pallas_call can't annotate vma yet
+        # pallas_call can't annotate vma yet; the matmul backend is plain XLA
+        check_vma = gd.plans is None or backend == "matmul"
 
         def local_loss(params, x, labels, mask, gd_block, key):
             gctx = GraphCtx(

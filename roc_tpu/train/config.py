@@ -41,7 +41,7 @@ class Config:
     num_parts: int = 1            # total shards (== mesh size when > 1)
     model: str = "gcn"            # gcn | sage | gin
     aggr: str = ""                # "" = model default; sum|avg|max|min
-    aggregate_backend: str = "xla"  # xla | pallas (blocked-CSR kernel)
+    aggregate_backend: str = "auto"  # auto | xla | matmul | pallas
     verbose: bool = False
     eval_every: int = 5           # reference evaluates every 5 epochs (gnn.cc:107)
     checkpoint_path: Optional[str] = None
@@ -72,8 +72,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-model", default="gcn", choices=["gcn", "sage", "gin"])
     p.add_argument("-aggr", default="",
                    choices=["", "sum", "avg", "max", "min"])
-    p.add_argument("-aggr-backend", dest="aggregate_backend", default="xla",
-                   choices=["xla", "pallas"])
+    p.add_argument("-aggr-backend", dest="aggregate_backend", default="auto",
+                   choices=["auto", "xla", "matmul", "pallas"])
     p.add_argument("-v", dest="verbose", action="store_true")
     p.add_argument("-eval-every", dest="eval_every", type=int, default=5)
     p.add_argument("-ckpt", dest="checkpoint_path", default=None)

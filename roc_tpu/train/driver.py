@@ -15,9 +15,9 @@ subclasses `BaseTrainer` for the mesh/shard_map path.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -30,13 +30,22 @@ from roc_tpu.optim.adam import Adam
 from roc_tpu.train.config import Config
 
 
-class DenseGraphData(NamedTuple):
+@dataclasses.dataclass
+class DenseGraphData:
     """Single-device edge arrays (a pytree, passed as jit args so the edge
-    lists are runtime buffers, not compile-time constants)."""
+    lists are runtime buffers, not compile-time constants).  ``backend`` is
+    pytree *metadata* — a static string shaping the traced program."""
     edge_src: jnp.ndarray   # [E] int32
     edge_dst: jnp.ndarray   # [E] int32, sorted
     in_degree: jnp.ndarray  # [N] float32
-    plans: object = None    # ops.AggregatePlans when backend == "pallas"
+    plans: object = None    # ops.AggregatePlans for plan-based backends
+    backend: str = dataclasses.field(default="xla", metadata={"static": True})
+
+
+jax.tree_util.register_dataclass(
+    DenseGraphData,
+    data_fields=["edge_src", "edge_dst", "in_degree", "plans"],
+    meta_fields=["backend"])
 
 
 def pallas_interpret() -> bool:
@@ -45,9 +54,25 @@ def pallas_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Above this many edges the "auto" backend switches from segment_sum to the
+# scatter-free matmul plan — on TPU only, where XLA scatter serializes per
+# index (measured ~6.5 s/aggregation at Reddit scale on v5e; see
+# roc_tpu/ops/aggregate.py).  CPU/GPU scatters are fine as-is.
+AUTO_MATMUL_EDGES = 1 << 20
+
+
+def resolve_backend(backend: str, num_edges: int) -> str:
+    if backend == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        return "matmul" if (on_tpu and num_edges >= AUTO_MATMUL_EDGES) \
+            else "xla"
+    return backend
+
+
 def dense_graph_data(graph, backend: str = "xla") -> DenseGraphData:
+    backend = resolve_backend(backend, graph.num_edges)
     plans = None
-    if backend == "pallas":
+    if backend in ("pallas", "matmul"):
         plans = ops.build_aggregate_plans(
             graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
     return DenseGraphData(
@@ -55,6 +80,7 @@ def dense_graph_data(graph, backend: str = "xla") -> DenseGraphData:
         edge_dst=jnp.asarray(graph.dst_idx, jnp.int32),
         in_degree=jnp.asarray(graph.in_degrees, jnp.float32),
         plans=plans,
+        backend=backend,
     )
 
 
@@ -63,8 +89,11 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
 
     def aggregate(x, aggr):
         if g.plans is not None and aggr == "sum":
-            return ops.scatter_gather_pallas(x, g.plans, num_nodes,
-                                             x.shape[0], interp)
+            if g.backend == "pallas":
+                return ops.scatter_gather_pallas(x, g.plans, num_nodes,
+                                                 x.shape[0], interp)
+            return ops.scatter_gather_matmul(x, g.plans, num_nodes,
+                                             x.shape[0])
         return ops.scatter_gather(x, g.edge_src, g.edge_dst, num_nodes, aggr)
     return GraphCtx(aggregate=aggregate, in_degree=g.in_degree)
 
@@ -92,16 +121,19 @@ class BaseTrainer:
         raise NotImplementedError
 
     def _effective_backend(self) -> str:
-        """The pallas kernel only implements sum aggregation; don't pay plan
-        construction when the built model contains no sum-aggregate op."""
+        """The plan-based backends (pallas/matmul) only implement sum
+        aggregation; don't pay plan construction when the built model
+        contains no sum-aggregate op."""
         cfg = self.config
+        backend = resolve_backend(cfg.aggregate_backend,
+                                  self.dataset.graph.num_edges)
         aggrs = {op.attrs["aggr"] for op in self.model.ops
                  if op.kind == "aggregate"}
-        if cfg.aggregate_backend == "pallas" and "sum" not in aggrs:
-            print(f"# aggregate_backend=pallas only accelerates sum "
+        if backend in ("pallas", "matmul") and "sum" not in aggrs:
+            print(f"# aggregate_backend={backend} only accelerates sum "
                   f"aggregation; this model uses {sorted(aggrs)} — using xla")
             return "xla"
-        return cfg.aggregate_backend
+        return backend
 
     def _run_step(self, step_key, alpha):
         self.params, self.opt_state, loss = self._train_step(
@@ -182,7 +214,8 @@ class Trainer(BaseTrainer):
 
     def _setup(self):
         ds, model = self.dataset, self.model
-        self.gdata = dense_graph_data(ds.graph, self._effective_backend())
+        backend = self._effective_backend()
+        self.gdata = dense_graph_data(ds.graph, backend)
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.labels, jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)

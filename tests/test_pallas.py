@@ -127,8 +127,10 @@ def test_training_pallas_equals_xla_sharded(halo):
 
 
 def test_empty_graph_plan():
+    from roc_tpu.ops.pallas.segment_sum import CPAD
     plan = build_chunk_plan(np.zeros(0, np.int32), np.zeros(0, np.int32), 10)
-    assert plan.num_chunks == plan.num_windows
+    # one (zeroing) chunk per window, padded up to the CPAD block size
+    assert plan.num_chunks == -(-plan.num_windows // CPAD) * CPAD
     x = jnp.ones((10, 8))
     plans = ops.build_aggregate_plans(np.zeros(0, np.int64),
                                       np.zeros(0, np.int64), 10, 10)
