@@ -52,13 +52,16 @@ def main():
     else:
         trainer = Trainer(cfg, ds, build_gcn(LAYERS, cfg.dropout_rate))
 
+    # device_sync fetches the loss to the host: each epoch's params feed the
+    # next, so syncing the last loss transitively waits on every step.
+    from roc_tpu.train.driver import device_sync
     for _ in range(WARMUP):
-        trainer.run_epoch()
-    jax.block_until_ready(trainer.params)
+        loss = trainer.run_epoch()
+    device_sync(loss)
     t1 = time.perf_counter()
     for _ in range(MEASURED):
-        trainer.run_epoch()
-    jax.block_until_ready(trainer.params)
+        loss = trainer.run_epoch()
+    device_sync(loss)
     epoch_s = (time.perf_counter() - t1) / MEASURED
 
     edges_per_sec_per_chip = ds.graph.num_edges / epoch_s / n_dev

@@ -52,7 +52,8 @@ def main(argv=None) -> int:
         print("error: one of -file or -dataset is required", file=sys.stderr)
         return 2
 
-    model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr)
+    model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
+                        heads=cfg.heads)
 
     if cfg.num_parts > 1:
         from roc_tpu.parallel.spmd import SpmdTrainer

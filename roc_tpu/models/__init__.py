@@ -2,15 +2,16 @@ from roc_tpu.models.model import GraphCtx, Model
 from roc_tpu.models.gcn import build_gcn
 from roc_tpu.models.sage import build_sage
 from roc_tpu.models.gin import build_gin
+from roc_tpu.models.gat import build_gat
 
 
 def build_model(name: str, layers, dropout_rate: float = 0.5,
-                aggr: str = "") -> Model:
+                aggr: str = "", heads: int = 8) -> Model:
     """Model registry keyed by the CLI's -model flag.
 
     aggr="" means "the model's own default" (gcn: sum — the reference's only
     wired AggrType; sage: avg; gin: sum, where a non-sum choice is rejected
-    because the GIN update is defined on sums)."""
+    because the GIN update is defined on sums).  heads only applies to gat."""
     if name == "gcn":
         return build_gcn(layers, dropout_rate, aggr or "sum")
     if name == "sage":
@@ -19,8 +20,10 @@ def build_model(name: str, layers, dropout_rate: float = 0.5,
         if aggr not in ("", "sum"):
             raise ValueError("gin is defined on sum aggregation")
         return build_gin(layers, dropout_rate)
-    raise ValueError(f"unknown model {name!r} (gcn|sage|gin)")
+    if name == "gat":
+        return build_gat(layers, dropout_rate, heads=heads)
+    raise ValueError(f"unknown model {name!r} (gcn|sage|gin|gat)")
 
 
 __all__ = ["Model", "GraphCtx", "build_gcn", "build_sage", "build_gin",
-           "build_model"]
+           "build_gat", "build_model"]

@@ -31,6 +31,10 @@ class GraphCtx(NamedTuple):
     """Everything an op needs to know about the (shard of the) graph."""
     aggregate: Callable[[jnp.ndarray, str], jnp.ndarray]  # x, aggr_type -> out
     in_degree: jnp.ndarray  # [N_local] float32, >= 1
+    # attention aggregation: (h [N,K,F], a_src [K,F], a_dst [K,F], slope)
+    # -> [N, K, F]; built by the same driver/spmd code that builds
+    # ``aggregate`` (it owns the halo/all_gather exchange).
+    attend: Optional[Callable] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,11 +96,27 @@ class Model:
         self.ops.append(OpNode("aggregate", (t.id,), out.id, {"aggr": aggr}))
         return out
 
+    def gat(self, t: TensorRef, head_dim: int, heads: int = 1,
+            slope: float = 0.2) -> TensorRef:
+        """Multi-head graph-attention layer (W-projection + attention
+        aggregation, heads concatenated).  Exercises the edge-tensor path
+        the reference left latent (create_edge_tensor, gnn.cc:534-589)."""
+        out = self._new(head_dim * heads)
+        self.ops.append(OpNode("gat", (t.id,), out.id,
+                               {"in_dim": t.dim, "head_dim": head_dim,
+                                "heads": heads, "slope": slope,
+                                "param": f"gat_{self.num_linear}"}))
+        self.num_linear += 1
+        return out
+
     def relu(self, t: TensorRef) -> TensorRef:
         return self._activation(t, "relu")
 
     def sigmoid(self, t: TensorRef) -> TensorRef:
         return self._activation(t, "sigmoid")
+
+    def elu(self, t: TensorRef) -> TensorRef:
+        return self._activation(t, "elu")
 
     def _activation(self, t: TensorRef, mode: str) -> TensorRef:
         out = self._new(t.dim)
@@ -129,6 +149,17 @@ class Model:
                 params[op.attrs["param"]] = ops.glorot_uniform(
                     k, op.attrs["in_dim"], op.attrs["out_dim"])
                 i += 1
+            elif op.kind == "gat":
+                name = op.attrs["param"]
+                kk, fd = op.attrs["heads"], op.attrs["head_dim"]
+                k = jax.random.fold_in(key, i)
+                params[name + "_w"] = ops.glorot_uniform(
+                    k, op.attrs["in_dim"], kk * fd)
+                for j, suff in enumerate(("_asrc", "_adst")):
+                    ka = jax.random.fold_in(k, j + 1)
+                    params[name + suff] = ops.glorot_uniform(
+                        ka, kk * fd, 1).reshape(kk, fd)
+                i += 1
         return params
 
     # -- execution --------------------------------------------------------
@@ -152,6 +183,15 @@ class Model:
                 out = ops.indegree_norm(a, gctx.in_degree)
             elif op.kind == "aggregate":
                 out = gctx.aggregate(a, op.attrs["aggr"])
+            elif op.kind == "gat":
+                assert gctx.attend is not None, \
+                    "this GraphCtx was built without attention support"
+                name = op.attrs["param"]
+                kk, fd = op.attrs["heads"], op.attrs["head_dim"]
+                h = ops.linear(a, params[name + "_w"]).reshape(-1, kk, fd)
+                out = gctx.attend(h, params[name + "_asrc"],
+                                  params[name + "_adst"],
+                                  op.attrs["slope"]).reshape(-1, kk * fd)
             elif op.kind == "activation":
                 out = ops.apply_activation(a, op.attrs["mode"])
             elif op.kind == "add":

@@ -1,9 +1,10 @@
 from roc_tpu.ops.aggregate import (
     AggregatePlans, build_aggregate_plans, pad_plans, scatter_gather,
     scatter_gather_matmul, scatter_gather_pallas)
+from roc_tpu.ops.edge import edge_softmax, gat_attend
 from roc_tpu.ops.norm import indegree_norm
 from roc_tpu.ops.linear import linear
-from roc_tpu.ops.activation import apply_activation, relu, sigmoid
+from roc_tpu.ops.activation import apply_activation, elu, relu, sigmoid
 from roc_tpu.ops.element import add, mul
 from roc_tpu.ops.dropout import dropout
 from roc_tpu.ops.softmax import (
@@ -12,7 +13,8 @@ from roc_tpu.ops.init import glorot_uniform
 
 __all__ = [
     "scatter_gather", "scatter_gather_matmul", "scatter_gather_pallas",
-    "indegree_norm", "linear", "relu", "sigmoid",
+    "edge_softmax", "gat_attend",
+    "indegree_norm", "linear", "relu", "sigmoid", "elu",
     "apply_activation", "add",
     "mul", "dropout", "PerfMetrics", "masked_softmax_cross_entropy",
     "perf_metrics", "glorot_uniform",

@@ -19,6 +19,12 @@ def sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
+def elu(x):
+    """GAT's activation (TPU extension; the reference enum stops at
+    sigmoid)."""
+    return jax.nn.elu(x)
+
+
 def apply_activation(x, mode: str):
     if mode == "none":
         return x
@@ -26,4 +32,6 @@ def apply_activation(x, mode: str):
         return relu(x)
     if mode == "sigmoid":
         return sigmoid(x)
+    if mode == "elu":
+        return elu(x)
     raise ValueError(f"unknown activation {mode!r}")

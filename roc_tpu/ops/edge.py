@@ -1,0 +1,60 @@
+"""Edge-tensor ops: per-edge scores, edge softmax, attention aggregation.
+
+The reference declares edge tensors as first-class (create_edge_tensor,
+gnn.cc:534-589; EDGE_TENSOR input paths in linear.cc:73-77,
+activation.cc:48-52, dropout.cc:42-46) but ships no op that produces one —
+the capability is latent (SURVEY.md §2.1).  Here edge tensors are realized
+the TPU way: an edge tensor is an [E, ...] array aligned with the CSR's
+dst-sorted edge order, sharded over the mesh's 'parts' axis by the same
+edge partition that shards edge_src/edge_dst (roc_tpu/graph/partition.py).
+
+These ops are what GAT-style models need: endpoint scores, a per-destination
+softmax over in-edges, and attention-weighted aggregation.  All are pure
+XLA (sorted segment reductions); pad edges are inert because the partitioner
+routes them to pad destination rows (partition.py edge padding invariants).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def edge_softmax(scores, edge_dst, num_nodes: int):
+    """Per-destination softmax over in-edges.
+
+    scores: [E, ...] (any trailing dims, e.g. one column per attention
+    head); edge_dst: [E] sorted ascending.  Returns alpha with
+    sum over {e : dst(e)=v} alpha[e] == 1 for every v with in-edges.
+    """
+    m = jax.ops.segment_max(scores, edge_dst, num_segments=num_nodes,
+                            indices_are_sorted=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)          # edgeless destinations
+    e = jnp.exp(scores - jnp.take(m, edge_dst, axis=0))
+    s = jax.ops.segment_sum(e, edge_dst, num_segments=num_nodes,
+                            indices_are_sorted=True)
+    return e / jnp.maximum(jnp.take(s, edge_dst, axis=0), 1e-38)
+
+
+def gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
+               a_src, a_dst, slope: float):
+    """Multi-head graph attention aggregation (GAT).
+
+    h:       [N_local, K, F] W-projected features of the *destination* rows.
+    table:   [T, K, F] source feature table (== h on one device; local rows
+             ++ halo rows, or the all-gathered tensor, under SPMD).
+    a_src/a_dst: [K, F] attention vectors (the two halves of the GAT `a`).
+    Per edge: s_e = LeakyReLU(a_dst.h[dst_e] + a_src.table[src_e]);
+    alpha = edge_softmax(s); out[v] = sum_e alpha_e * table[src_e].
+    Returns [N_local, K, F].
+    """
+    as_t = jnp.einsum("tkf,kf->tk", table, a_src)     # [T, K]
+    ad_l = jnp.einsum("nkf,kf->nk", h, a_dst)         # [N_local, K]
+    s = jax.nn.leaky_relu(
+        jnp.take(ad_l, edge_dst, axis=0) + jnp.take(as_t, edge_src, axis=0),
+        negative_slope=slope)                          # [E, K]
+    alpha = edge_softmax(s, edge_dst, num_nodes)       # [E, K]
+    g = jnp.take(table, edge_src, axis=0)              # [E, K, F]
+    return jax.ops.segment_sum(g * alpha[:, :, None], edge_dst,
+                               num_segments=num_nodes,
+                               indices_are_sorted=True)

@@ -85,22 +85,27 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
     )
 
 
-def _shard_aggregate_fn(gd_block, shard_nodes: int, use_halo: bool):
-    """Build the per-shard GraphCtx.aggregate closure (runs inside shard_map;
-    gd_block fields already have the leading parts-axis block squeezed)."""
+def _exchange(gd_block, use_halo: bool, x):
+    """Materialize the per-shard source table for a [S, H] local tensor:
+    local rows ++ halo rows (one all_to_all) or the all-gathered tensor."""
+    if use_halo:
+        send = jnp.take(x, gd_block.send_idx, axis=0)           # [P, K, H]
+        recv = jax.lax.all_to_all(send, PARTS_AXIS,
+                                  split_axis=0, concat_axis=0)
+        return jnp.concatenate(
+            [x, recv.reshape(-1, x.shape[-1])], axis=0)         # [S+P*K, H]
+    return jax.lax.all_gather(x, PARTS_AXIS, tiled=True)        # [P*S, H]
+
+
+def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
+    """Build the per-shard GraphCtx (runs inside shard_map; gd_block fields
+    already have the leading parts-axis block squeezed)."""
     from roc_tpu.train.driver import pallas_interpret
     edge_src, edge_dst = gd_block.edge_src, gd_block.edge_dst
     interp = pallas_interpret()
 
     def aggregate(x, aggr):
-        if use_halo:
-            send = jnp.take(x, gd_block.send_idx, axis=0)       # [P, K, H]
-            recv = jax.lax.all_to_all(send, PARTS_AXIS,
-                                      split_axis=0, concat_axis=0)
-            table = jnp.concatenate(
-                [x, recv.reshape(-1, x.shape[-1])], axis=0)     # [S+P*K, H]
-        else:
-            table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)  # [P*S, H]
+        table = _exchange(gd_block, use_halo, x)
         if gd_block.plans is not None and aggr == "sum":
             if gd_block.backend == "pallas":
                 return ops.scatter_gather_pallas(table, gd_block.plans,
@@ -110,7 +115,15 @@ def _shard_aggregate_fn(gd_block, shard_nodes: int, use_halo: bool):
                                              shard_nodes, table.shape[0])
         return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
                                   aggr)
-    return aggregate
+
+    def attend(h, a_src, a_dst, slope):
+        kk, fd = h.shape[1], h.shape[2]
+        table = _exchange(gd_block, use_halo, h.reshape(h.shape[0], kk * fd))
+        return ops.gat_attend(h, table.reshape(-1, kk, fd), edge_src,
+                              edge_dst, shard_nodes, a_src, a_dst, slope)
+
+    return GraphCtx(aggregate=aggregate, in_degree=gd_block.in_degree,
+                    attend=attend)
 
 
 def _squeeze_gd(gd: ShardedGraphData) -> ShardedGraphData:
@@ -157,9 +170,7 @@ class SpmdTrainer(BaseTrainer):
         check_vma = gd.plans is None or backend == "matmul"
 
         def local_loss(params, x, labels, mask, gd_block, key):
-            gctx = GraphCtx(
-                aggregate=_shard_aggregate_fn(gd_block, S, use_halo),
-                in_degree=gd_block.in_degree)
+            gctx = _shard_gctx(gd_block, S, use_halo)
             return model.loss(params, x, labels, mask, gctx, key=key,
                               train=True)
 
@@ -189,9 +200,7 @@ class SpmdTrainer(BaseTrainer):
                  out_specs=P())
         def eval_shard(params, x, labels, mask, gd):
             gd = _squeeze_gd(gd)
-            gctx = GraphCtx(
-                aggregate=_shard_aggregate_fn(gd, S, use_halo),
-                in_degree=gd.in_degree)
+            gctx = _shard_gctx(gd, S, use_halo)
             logits = model.apply(params, x, gctx, train=False)
             m = ops.perf_metrics(logits, labels, mask)
             return jax.tree.map(lambda v: jax.lax.psum(v, PARTS_AXIS), m)

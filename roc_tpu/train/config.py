@@ -39,7 +39,8 @@ class Config:
     decay_steps: int = 100
     seed: int = 1
     num_parts: int = 1            # total shards (== mesh size when > 1)
-    model: str = "gcn"            # gcn | sage | gin
+    model: str = "gcn"            # gcn | sage | gin | gat
+    heads: int = 8                # attention heads (gat only)
     aggr: str = ""                # "" = model default; sum|avg|max|min
     aggregate_backend: str = "auto"  # auto | xla | matmul | pallas
     verbose: bool = False
@@ -69,7 +70,9 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-seed", type=int, default=1)
     p.add_argument("-parts", "-ng", "-ll:gpu", dest="num_parts", type=int,
                    default=1)
-    p.add_argument("-model", default="gcn", choices=["gcn", "sage", "gin"])
+    p.add_argument("-model", default="gcn",
+                   choices=["gcn", "sage", "gin", "gat"])
+    p.add_argument("-heads", type=int, default=8)
     p.add_argument("-aggr", default="",
                    choices=["", "sum", "avg", "max", "min"])
     p.add_argument("-aggr-backend", dest="aggregate_backend", default="auto",

@@ -54,6 +54,14 @@ def pallas_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def device_sync(x):
+    """True host sync on the first leaf of ``x`` (a literal device→host
+    transfer).  On tunneled platforms jax.block_until_ready can return
+    before execution finishes; a transfer cannot."""
+    import numpy as np
+    return np.asarray(jax.tree.leaves(x)[0])
+
+
 # Above this many edges the "auto" backend switches from segment_sum to the
 # scatter-free matmul plan — on TPU only, where XLA scatter serializes per
 # index (measured ~6.5 s/aggregation at Reddit scale on v5e; see
@@ -95,7 +103,14 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
             return ops.scatter_gather_matmul(x, g.plans, num_nodes,
                                              x.shape[0])
         return ops.scatter_gather(x, g.edge_src, g.edge_dst, num_nodes, aggr)
-    return GraphCtx(aggregate=aggregate, in_degree=g.in_degree)
+
+    def attend(h, a_src, a_dst, slope):
+        # single device: the source table IS the local tensor
+        return ops.gat_attend(h, h, g.edge_src, g.edge_dst, num_nodes,
+                              a_src, a_dst, slope)
+
+    return GraphCtx(aggregate=aggregate, in_degree=g.in_degree,
+                    attend=attend)
 
 
 class BaseTrainer:
@@ -130,8 +145,10 @@ class BaseTrainer:
         aggrs = {op.attrs["aggr"] for op in self.model.ops
                  if op.kind == "aggregate"}
         if backend in ("pallas", "matmul") and "sum" not in aggrs:
-            print(f"# aggregate_backend={backend} only accelerates sum "
-                  f"aggregation; this model uses {sorted(aggrs)} — using xla")
+            if cfg.aggregate_backend != "auto":   # user explicitly chose it
+                print(f"# aggregate_backend={backend} only accelerates sum "
+                      f"aggregation; this model uses {sorted(aggrs)} — "
+                      f"using xla")
             return "xla"
         return backend
 
@@ -171,10 +188,10 @@ class BaseTrainer:
                 tracing = True
             te = time.perf_counter()
             loss = self.run_epoch()
-            jax.block_until_ready(loss)
+            device_sync(loss)
             self.epoch_times.append(time.perf_counter() - te)
             if tracing and epoch + 1 == prof_stop:
-                jax.block_until_ready(self.params)
+                device_sync(self.params)
                 jax.profiler.stop_trace()
                 tracing = False
                 print_fn(f"# profiler trace written to {cfg.profile_dir}")
@@ -184,7 +201,7 @@ class BaseTrainer:
             if (cfg.checkpoint_path and cfg.checkpoint_every and
                     (epoch + 1) % cfg.checkpoint_every == 0):
                 self.save_checkpoint(cfg.checkpoint_path)
-        jax.block_until_ready(self.params)
+        device_sync(self.params)
         dt = time.perf_counter() - t0
         if cfg.checkpoint_path:
             self.save_checkpoint(cfg.checkpoint_path)

@@ -142,9 +142,10 @@ def test_empty_graph():
 
 
 def test_auto_backend_resolution(monkeypatch):
-    # on non-TPU platforms auto always picks xla (native scatter is fine)
-    assert resolve_backend("auto", 1 << 21) == "xla"
     assert resolve_backend("pallas", 100) == "pallas"
+    # on non-TPU platforms auto always picks xla (native scatter is fine)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert resolve_backend("auto", 1 << 21) == "xla"
     # on TPU, auto switches by edge count
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert resolve_backend("auto", 100) == "xla"

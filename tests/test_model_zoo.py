@@ -64,4 +64,4 @@ def test_zoo_models_sharded_match_single(name):
 
 def test_unknown_model_rejected():
     with pytest.raises(ValueError, match="unknown model"):
-        build_model("gat", [4, 2])
+        build_model("transformer", [4, 2])
