@@ -41,7 +41,7 @@ def main(argv=None) -> int:
 
     if cfg.filename:
         ds = datasets.load_roc_dataset(cfg.filename, cfg.layers[0],
-                                       cfg.layers[-1])
+                                       cfg.layers[-1], lazy=cfg.lazy_load)
     elif cfg.dataset:
         ds = datasets.get(cfg.dataset, seed=cfg.seed)
         assert ds.in_dim == cfg.layers[0], (

@@ -25,27 +25,39 @@ from roc_tpu.graph.csr import Csr, add_self_edges, from_edges
 class Dataset:
     name: str
     graph: Csr              # includes self-edges (the reference's input contract)
-    features: np.ndarray    # [N, in_dim] float32
-    labels: np.ndarray      # [N, C] one-hot float32 (reference label layout)
+    features: np.ndarray    # [N, in_dim] float32 (may be a read-only memmap)
+    labels: "np.ndarray | None"  # [N, C] one-hot float32, or None when lazy
     label_ids: np.ndarray   # [N] int64
     mask: np.ndarray        # [N] int32 in {TRAIN, VAL, TEST, NONE}
     in_dim: int
     num_classes: int
 
+    def onehot_labels(self) -> np.ndarray:
+        """One-hot labels, materialized on demand (lazy datasets skip the
+        [N, C] float32 allocation — 69 GB at papers100M scale)."""
+        if self.labels is not None:
+            return self.labels
+        return lux.one_hot(self.label_ids, self.num_classes)
+
 
 def load_roc_dataset(prefix: str, in_dim: int, num_classes: int,
-                     name: str = "") -> Dataset:
+                     name: str = "", lazy: bool = False) -> Dataset:
     """Load a dataset laid out in the reference's on-disk format.
 
     ``in_dim``/``num_classes`` come from the layer spec exactly as in the
     reference CLI (`-layers 602-256-41` supplies both, gnn.cc:68-69).
+    ``lazy=True`` memory-maps features and defers one-hot label expansion —
+    the sharded-host-loading mode: each host's per-part placement then reads
+    only its own vertex ranges from disk (the TPU analog of the reference's
+    per-partition `.lux` seeking, load_task.cu:231-243).
     """
     g = lux.read_lux(prefix + lux.LUX_SUFFIX)
-    feats = lux.load_features(prefix, g.num_nodes, in_dim)
-    onehot = lux.load_labels(prefix, g.num_nodes, num_classes)
+    feats = lux.load_features(prefix, g.num_nodes, in_dim, mmap=lazy)
+    ids = lux.load_label_ids(prefix, g.num_nodes, num_classes)
     mask = lux.load_mask(prefix, g.num_nodes)
-    return Dataset(name or prefix, g, feats, onehot,
-                   np.argmax(onehot, axis=1), mask, in_dim, num_classes)
+    onehot = None if lazy else lux.one_hot(ids, num_classes)
+    return Dataset(name or prefix, g, feats, onehot, ids, mask, in_dim,
+                   num_classes)
 
 
 def synthetic(name: str, num_nodes: int, avg_degree: float, in_dim: int,

@@ -70,36 +70,68 @@ def write_lux(path: str, g: Csr) -> None:
         g.col_idx.astype(np.uint32).tofile(f)
 
 
-def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
+def load_features(prefix: str, num_nodes: int, in_dim: int,
+                  mmap: bool = False) -> np.ndarray:
     """Load node features, preferring the `.feats.bin` cache and writing it
-    after a CSV parse, exactly like the reference (load_task.cu:41-73)."""
+    after a CSV parse, exactly like the reference (load_task.cu:41-73).
+
+    ``mmap=True`` returns a read-only np.memmap of the binary cache instead
+    of materializing [N, in_dim] in RAM — the sharded-host-loading path for
+    graphs whose features exceed host memory (SURVEY §7 "papers100M"):
+    per-part placement then touches only this host's row ranges."""
     bin_path = prefix + ".feats.bin"
+    if not os.path.exists(bin_path):
+        csv_path = prefix + ".feats.csv"
+        from roc_tpu import native
+        if native.available():
+            feats = native.parse_feats_csv(csv_path, num_nodes, in_dim)
+        else:
+            feats = np.loadtxt(csv_path, delimiter=",", dtype=np.float32,
+                               ndmin=2)
+            assert feats.shape == (num_nodes, in_dim), (
+                f"feats.csv shape {feats.shape} != ({num_nodes},{in_dim})")
+        feats.tofile(bin_path)
+        if not mmap:
+            return feats
+    if mmap:
+        return np.memmap(bin_path, dtype=np.float32, mode="r",
+                         shape=(num_nodes, in_dim))
+    feats = np.fromfile(bin_path, dtype=np.float32, count=num_nodes * in_dim)
+    assert feats.size == num_nodes * in_dim, "feats.bin size mismatch"
+    return feats.reshape(num_nodes, in_dim)
+
+
+def one_hot(ids: np.ndarray, num_classes: int) -> np.ndarray:
+    """[...,] int ids -> [..., C] float32 one-hot (the reference's on-host
+    label layout, load_task.cu:110-123)."""
+    out = np.zeros(ids.shape + (num_classes,), dtype=np.float32)
+    out.reshape(-1, num_classes)[np.arange(ids.size), ids.reshape(-1)] = 1.0
+    return out
+
+
+def load_label_ids(prefix: str, num_nodes: int,
+                   num_classes: int) -> np.ndarray:
+    """Load `.label` int class ids, caching the text parse to `.label.bin`
+    (same pattern as the `.feats.bin` cache — a 1e8-line text parse costs
+    minutes; the binary reload is instant)."""
+    bin_path = prefix + ".label.bin"
     if os.path.exists(bin_path):
-        feats = np.fromfile(bin_path, dtype=np.float32, count=num_nodes * in_dim)
-        assert feats.size == num_nodes * in_dim, "feats.bin size mismatch"
-        return feats.reshape(num_nodes, in_dim)
-    csv_path = prefix + ".feats.csv"
-    from roc_tpu import native
-    if native.available():
-        feats = native.parse_feats_csv(csv_path, num_nodes, in_dim)
+        ids = np.fromfile(bin_path, dtype=np.int32, count=num_nodes)
+        assert ids.size == num_nodes, "label.bin size mismatch"
+        ids = ids.astype(np.int64)
     else:
-        feats = np.loadtxt(csv_path, delimiter=",", dtype=np.float32,
-                           ndmin=2)
-        assert feats.shape == (num_nodes, in_dim), (
-            f"feats.csv shape {feats.shape} != ({num_nodes},{in_dim})")
-    feats.tofile(bin_path)
-    return feats
+        ids = np.loadtxt(prefix + ".label", dtype=np.int64).reshape(-1)
+        assert ids.shape[0] == num_nodes
+        ids.astype(np.int32).tofile(bin_path)
+    assert ids.min() >= 0 and ids.max() < num_classes
+    return ids
 
 
 def load_labels(prefix: str, num_nodes: int, num_classes: int) -> np.ndarray:
     """Load int class ids and expand to one-hot float32 rows
     (load_task.cu:110-123)."""
-    ids = np.loadtxt(prefix + ".label", dtype=np.int64).reshape(-1)
-    assert ids.shape[0] == num_nodes
-    assert ids.min() >= 0 and ids.max() < num_classes
-    onehot = np.zeros((num_nodes, num_classes), dtype=np.float32)
-    onehot[np.arange(num_nodes), ids] = 1.0
-    return onehot
+    return one_hot(load_label_ids(prefix, num_nodes, num_classes),
+                   num_classes)
 
 
 def load_mask(prefix: str, num_nodes: int) -> np.ndarray:

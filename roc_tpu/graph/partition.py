@@ -151,6 +151,20 @@ class Partition:
                 out[p * self.shard_nodes: p * self.shard_nodes + n] = x[lo: hi + 1]
         return out
 
+    def pad_part(self, x: np.ndarray, p: int, fill=0,
+                 dtype=None) -> np.ndarray:
+        """One part's padded [S, ...] block, touching only rows
+        [lo_p, hi_p] of ``x`` — with a memmapped ``x`` this reads just this
+        part's bytes from disk (sharded host loading; the analog of the
+        reference's per-partition `.lux` seeking, load_task.cu:231-243)."""
+        lo, hi = self.bounds[p]
+        n = max(int(hi - lo + 1), 0)
+        out = np.full((self.shard_nodes,) + x.shape[1:], fill,
+                      dtype=dtype or x.dtype)
+        if n > 0:
+            out[:n] = x[lo: hi + 1]
+        return out
+
     def unpad_nodes(self, x: np.ndarray) -> np.ndarray:
         """Inverse of pad_nodes (drops pad rows)."""
         parts = []

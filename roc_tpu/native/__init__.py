@@ -69,6 +69,15 @@ def lib() -> Optional[ctypes.CDLL]:
     L.roc_parse_feats_csv.restype = ctypes.c_int64
     L.roc_in_degrees.argtypes = [u64p, ctypes.c_uint64, f32p]
     L.roc_in_degrees.restype = None
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    L.roc_plan_geometry.argtypes = [i64p]
+    L.roc_plan_geometry.restype = None
+    L.roc_chunk_plan_count.argtypes = [i32p, ctypes.c_int64, ctypes.c_int64]
+    L.roc_chunk_plan_count.restype = ctypes.c_int64
+    L.roc_chunk_plan_fill.argtypes = [i32p, i32p, ctypes.c_int64,
+                                      ctypes.c_int64, ctypes.c_int64,
+                                      i32p, i32p, i32p, i32p]
+    L.roc_chunk_plan_fill.restype = ctypes.c_int64
     _lib = L
     return _lib
 
@@ -144,3 +153,33 @@ def in_degrees(raw_rows: np.ndarray) -> np.ndarray:
     out = np.empty(len(raw_rows), np.float32)
     L.roc_in_degrees(raw_rows, len(raw_rows), out)
     return out
+
+
+def chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int):
+    """Aggregation chunk schedule (see segment_sum.build_chunk_plan).
+
+    Returns (obi [C], first [C], esrc [C, EB], edst [C, EB]) int32 arrays,
+    C already CPAD-padded.  The chunk geometry (VB/EB/CPAD) is owned by
+    roc_tpu.ops.pallas.segment_sum; the C++ side exports its compiled-in
+    values and we assert they agree before trusting the native plan."""
+    L = lib()
+    assert L is not None
+    from roc_tpu.ops.pallas.segment_sum import CPAD, EB, VB
+    geo = np.zeros(3, np.int64)
+    L.roc_plan_geometry(geo)
+    assert tuple(geo) == (VB, EB, CPAD), (
+        f"native plan geometry {tuple(geo)} != python ({VB}, {EB}, {CPAD}); "
+        f"rebuild roc_tpu/native after changing segment_sum constants")
+    src = np.ascontiguousarray(edge_src, np.int32)
+    dst = np.ascontiguousarray(edge_dst, np.int32)
+    E = len(src)
+    C = int(L.roc_chunk_plan_count(dst, E, num_rows))
+    obi = np.empty(C, np.int32)
+    first = np.empty(C, np.int32)
+    esrc = np.empty((C, EB), np.int32)
+    edst = np.empty((C, EB), np.int32)
+    rc = L.roc_chunk_plan_fill(src, dst, E, num_rows, C, obi, first,
+                               esrc.reshape(-1), edst.reshape(-1))
+    if rc != 0:
+        raise RuntimeError(f"roc_chunk_plan_fill rc={rc}")
+    return obi, first, esrc, edst

@@ -176,6 +176,91 @@ int64_t roc_parse_feats_csv(const char* path, int64_t num_rows,
   return r;
 }
 
+// ---------------------------------------------------------------------------
+// Chunk-plan builder for the TPU aggregation backends (the host-side
+// "scheduler" of roc_tpu/ops/pallas/segment_sum.py::build_chunk_plan —
+// identical semantics, linear single pass).  The dst-sorted edge list is cut
+// into chunks of EB edge slots, each owning a VB-row output window; sparse
+// windows get one padded (zeroing) chunk; the chunk count is padded to a
+// multiple of CPAD.  At ogbn-papers100M scale (1.6e9 edges) the NumPy plan
+// build costs minutes; this runs at memory speed.
+// ---------------------------------------------------------------------------
+
+static const int64_t PLAN_VB = 8, PLAN_EB = 256, PLAN_CPAD = 8;
+
+// Export the compiled-in geometry so the Python side (whose
+// segment_sum.VB/EB/CPAD are the source of truth) can assert agreement.
+void roc_plan_geometry(int64_t* out3) {
+  out3[0] = PLAN_VB;
+  out3[1] = PLAN_EB;
+  out3[2] = PLAN_CPAD;
+}
+
+// Number of chunks (already CPAD-padded) for a dst-sorted edge list.
+int64_t roc_chunk_plan_count(const int32_t* dst, int64_t num_edges,
+                             int64_t num_rows) {
+  int64_t windows = (num_rows + PLAN_VB - 1) / PLAN_VB;
+  if (windows < 1) windows = 1;
+  int64_t C = 0, e = 0;
+  for (int64_t w = 0; w < windows; w++) {
+    int64_t hi = (w + 1) * PLAN_VB;
+    int64_t cnt = 0;
+    while (e < num_edges && dst[e] < hi) { e++; cnt++; }
+    int64_t nc = (cnt + PLAN_EB - 1) / PLAN_EB;
+    C += nc < 1 ? 1 : nc;
+  }
+  return (C + PLAN_CPAD - 1) / PLAN_CPAD * PLAN_CPAD;
+}
+
+// Fill obi/first/esrc/edst (each caller-allocated: [C], [C], [C*EB], [C*EB]).
+// Returns 0 on success, -1 if the passed C does not match.
+int64_t roc_chunk_plan_fill(const int32_t* src, const int32_t* dst,
+                            int64_t num_edges, int64_t num_rows, int64_t C,
+                            int32_t* obi, int32_t* first, int32_t* esrc,
+                            int32_t* edst) {
+  int64_t windows = (num_rows + PLAN_VB - 1) / PLAN_VB;
+  if (windows < 1) windows = 1;
+  int64_t c = 0, e = 0;
+  for (int64_t w = 0; w < windows; w++) {
+    int64_t hi = (w + 1) * PLAN_VB;
+    int64_t start = e;
+    while (e < num_edges && dst[e] < hi) e++;
+    int64_t cnt = e - start;
+    int64_t nc = (cnt + PLAN_EB - 1) / PLAN_EB;
+    if (nc < 1) nc = 1;
+    for (int64_t j = 0; j < nc; j++, c++) {
+      if (c >= C) return -1;
+      obi[c] = (int32_t)w;
+      first[c] = j == 0;
+      int64_t lo = start + j * PLAN_EB;
+      int64_t take = cnt - j * PLAN_EB;
+      if (take > PLAN_EB) take = PLAN_EB;
+      if (take < 0) take = 0;
+      int32_t* es = esrc + c * PLAN_EB;
+      int32_t* ed = edst + c * PLAN_EB;
+      for (int64_t k = 0; k < take; k++) {
+        es[k] = src[lo + k];
+        ed[k] = (int32_t)(dst[lo + k] - w * PLAN_VB);
+      }
+      for (int64_t k = take; k < PLAN_EB; k++) {
+        es[k] = 0;
+        ed[k] = (int32_t)PLAN_VB;  // masked pad slot
+      }
+    }
+  }
+  // CPAD padding: no-op chunks against the last window.
+  int32_t last = c ? obi[c - 1] : 0;
+  for (; c < C; c++) {
+    obi[c] = last;
+    first[c] = 0;
+    for (int64_t k = 0; k < PLAN_EB; k++) {
+      esrc[c * PLAN_EB + k] = 0;
+      edst[c * PLAN_EB + k] = (int32_t)PLAN_VB;
+    }
+  }
+  return 0;
+}
+
 // In-degree computation from inclusive end offsets (device CSR build prep;
 // the reference does this on-GPU in init_graph_kernel, load_task.cu:271-294
 // — on TPU the degree vector is a host-side precompute).

@@ -86,15 +86,25 @@ def build_chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     """Cut a dst-sorted edge list into (window, chunk) slots.
 
     edge_src: [E] table row per edge; edge_dst: [E] sorted dst row in
-    [0, num_rows).  Works for any E including 0.  Fully vectorized — the
-    reference workloads have 1e8 edges and this runs per shard per
-    direction at startup.
+    [0, num_rows).  Works for any E including 0.  The native C++ builder
+    (roc_chunk_plan_*) runs at memory speed for big edge lists; the
+    vectorized-NumPy path below is the fallback and correctness oracle.
     """
     assert edge_src.shape == edge_dst.shape
     edge_src = np.asarray(edge_src, np.int64)
     edge_dst = np.asarray(edge_dst, np.int64)
     E = edge_src.shape[0]
     assert E == 0 or np.all(np.diff(edge_dst) >= 0), "edge_dst not sorted"
+
+    from roc_tpu import native
+    if E >= (1 << 20) and native.available():
+        obi, first, esrc, edst = native.chunk_plan(edge_src, edge_dst,
+                                                   num_rows)
+        num_windows = max((num_rows + VB - 1) // VB, 1)
+        return ChunkPlan(
+            num_chunks=obi.shape[0], num_windows=num_windows,
+            obi=obi, first=first, esrc=esrc, edst=edst,
+            out_rows=num_windows * VB)
     num_windows = max((num_rows + VB - 1) // VB, 1)
     win_of_edge = edge_dst // VB
     win_start = np.searchsorted(win_of_edge, np.arange(num_windows), "left")

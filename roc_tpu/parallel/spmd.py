@@ -135,6 +135,20 @@ def _squeeze_gd(gd: ShardedGraphData) -> ShardedGraphData:
 class SpmdTrainer(BaseTrainer):
     """Multi-chip trainer: same Trainer interface, mesh underneath."""
 
+    def _place_nodes(self, part_loader, spec: NamedSharding):
+        """Assemble a global node tensor from per-part host blocks, placing
+        each part directly on its device.  Under `jax.distributed` each
+        process only loads/places the parts of its addressable devices."""
+        devices = list(self.mesh.devices.reshape(-1))
+        pidx = jax.process_index()
+        shards = [jax.device_put(part_loader(p), d)
+                  for p, d in enumerate(devices) if d.process_index == pidx]
+        sample = shards[0]
+        global_shape = (self.part.num_parts * self.part.shard_nodes,) \
+            + sample.shape[1:]
+        return jax.make_array_from_single_device_arrays(
+            global_shape, spec, shards)
+
     def _setup(self):
         cfg, ds, model = self.config, self.dataset, self.model
         P_ = cfg.num_parts
@@ -146,14 +160,25 @@ class SpmdTrainer(BaseTrainer):
         node_spec = NamedSharding(self.mesh, P(PARTS_AXIS))
         repl_spec = NamedSharding(self.mesh, P())
 
-        # Node tensors: [P*S, ...], padded + permuted, sharded on axis 0.
-        pad = self.part.pad_nodes
-        self.x = jax.device_put(
-            pad(ds.features).astype(self.dtype), node_spec)
-        self.labels = jax.device_put(pad(ds.labels), node_spec)
+        # Node tensors: [P*S, ...], padded + permuted, sharded on axis 0 —
+        # placed PER DEVICE so no host materializes the full padded array
+        # and, under multihost, each process reads only its own parts from
+        # (possibly memmapped) storage: sharded host loading.
+        self.x = self._place_nodes(
+            lambda p: self.part.pad_part(ds.features, p,
+                                         dtype=np.dtype(self.dtype)),
+            node_spec)
+        from roc_tpu.graph.lux import one_hot
+
+        def onehot_part(p):
+            # pad rows carry label 0; harmless — their mask is NONE
+            ids = self.part.pad_part(ds.label_ids, p, fill=0)
+            return one_hot(ids, ds.num_classes)
+        self.labels = self._place_nodes(onehot_part, node_spec)
         # Pad rows get MASK_NONE so they never count in loss or metrics.
-        self.mask = jax.device_put(
-            pad(ds.mask, fill=MASK_NONE).astype(np.int32), node_spec)
+        self.mask = self._place_nodes(
+            lambda p: self.part.pad_part(ds.mask, p, fill=MASK_NONE,
+                                         dtype=np.int32), node_spec)
 
         backend = self._effective_backend()
         gd = shard_graph(self.part, self.halo, backend)

@@ -49,6 +49,8 @@ class Config:
     checkpoint_every: int = 0     # 0 = disabled
     resume: bool = False
     use_bf16: bool = False        # opt-in activation bf16 (SURVEY §7 non-goal note)
+    lazy_load: bool = False       # memmap features / defer one-hot labels
+                                  # (sharded host loading for huge graphs)
     halo: bool = True             # v1 halo exchange vs v0 all_gather
     profile_dir: str = ""         # write a jax.profiler trace of epochs 3-5
     multihost: bool = False       # jax.distributed.initialize() before run
@@ -83,6 +85,7 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-ckpt-every", dest="checkpoint_every", type=int, default=0)
     p.add_argument("-resume", action="store_true")
     p.add_argument("-bf16", dest="use_bf16", action="store_true")
+    p.add_argument("-lazy", dest="lazy_load", action="store_true")
     p.add_argument("-no-halo", dest="halo", action="store_false")
     p.add_argument("-profile", dest="profile_dir", default="")
     p.add_argument("-multihost", action="store_true")

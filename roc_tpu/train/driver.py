@@ -234,7 +234,7 @@ class Trainer(BaseTrainer):
         backend = self._effective_backend()
         self.gdata = dense_graph_data(ds.graph, backend)
         self.x = jnp.asarray(ds.features, self.dtype)
-        self.labels = jnp.asarray(ds.labels, jnp.float32)
+        self.labels = jnp.asarray(ds.onehot_labels(), jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)
         self.params = model.init_params(self.key)
         self.opt_state = self.optimizer.init(self.params)

@@ -113,6 +113,23 @@ def test_in_degrees(built, ds):
         deg, np.diff(ds.graph.row_ptr).astype(np.float32))
 
 
+@pytest.mark.parametrize("shape", [(500, 9000), (64, 0), (40, 1000),
+                                   (1, 17)])
+def test_chunk_plan_native_equals_numpy(built, shape):
+    # native builder vs the vectorized-NumPy oracle in build_chunk_plan
+    from roc_tpu.ops.pallas.segment_sum import build_chunk_plan
+    n, e = shape
+    rng = np.random.default_rng(n + e)
+    src = rng.integers(0, max(n, 1), e).astype(np.int64)
+    dst = np.sort(rng.integers(0, max(n, 1), e)).astype(np.int64)
+    plan = build_chunk_plan(src, dst, n)          # E < 2^20 -> NumPy path
+    obi, first, esrc, edst = built.chunk_plan(src, dst, n)
+    np.testing.assert_array_equal(obi, plan.obi)
+    np.testing.assert_array_equal(first, plan.first)
+    np.testing.assert_array_equal(esrc, plan.esrc)
+    np.testing.assert_array_equal(edst, plan.edst)
+
+
 def test_load_features_uses_native_and_caches(built, ds, tmp_path):
     prefix = str(tmp_path / "d")
     np.savetxt(prefix + ".feats.csv", ds.features, delimiter=",", fmt="%.6g")
