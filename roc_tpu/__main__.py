@@ -58,6 +58,11 @@ def main(argv=None) -> int:
     if cfg.num_parts > 1:
         from roc_tpu.parallel.spmd import SpmdTrainer
         trainer = SpmdTrainer(cfg, ds, model)
+        if cfg.check_sharding:
+            from roc_tpu.parallel.check import check_shard_consistency
+            check_shard_consistency(cfg, ds, model, sharded_trainer=trainer)
+            print("# shard-consistency check passed "
+                  f"({cfg.num_parts} parts, halo={cfg.halo})", file=sys.stderr)
     else:
         trainer = Trainer(cfg, ds, model)
     trainer.train()

@@ -70,6 +70,24 @@ def write_lux(path: str, g: Csr) -> None:
         g.col_idx.astype(np.uint32).tofile(f)
 
 
+def _cache_fresh(bin_path: str, src_path: str) -> bool:
+    """A binary sidecar cache is usable iff it exists and is no older than
+    its source text file (a regenerated source invalidates it, like make)."""
+    if not os.path.exists(bin_path):
+        return False
+    if not os.path.exists(src_path):
+        return True      # binary-only distribution
+    return os.path.getmtime(bin_path) >= os.path.getmtime(src_path)
+
+
+def _atomic_tofile(arr: np.ndarray, path: str) -> None:
+    """Write-then-rename so concurrent readers (multihost processes on
+    shared storage) never observe a truncated cache file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    arr.tofile(tmp)
+    os.replace(tmp, path)
+
+
 def load_features(prefix: str, num_nodes: int, in_dim: int,
                   mmap: bool = False) -> np.ndarray:
     """Load node features, preferring the `.feats.bin` cache and writing it
@@ -80,8 +98,8 @@ def load_features(prefix: str, num_nodes: int, in_dim: int,
     graphs whose features exceed host memory (SURVEY §7 "papers100M"):
     per-part placement then touches only this host's row ranges."""
     bin_path = prefix + ".feats.bin"
-    if not os.path.exists(bin_path):
-        csv_path = prefix + ".feats.csv"
+    csv_path = prefix + ".feats.csv"
+    if not _cache_fresh(bin_path, csv_path):
         from roc_tpu import native
         if native.available():
             feats = native.parse_feats_csv(csv_path, num_nodes, in_dim)
@@ -90,7 +108,7 @@ def load_features(prefix: str, num_nodes: int, in_dim: int,
                                ndmin=2)
             assert feats.shape == (num_nodes, in_dim), (
                 f"feats.csv shape {feats.shape} != ({num_nodes},{in_dim})")
-        feats.tofile(bin_path)
+        _atomic_tofile(feats, bin_path)
         if not mmap:
             return feats
     if mmap:
@@ -115,14 +133,14 @@ def load_label_ids(prefix: str, num_nodes: int,
     (same pattern as the `.feats.bin` cache — a 1e8-line text parse costs
     minutes; the binary reload is instant)."""
     bin_path = prefix + ".label.bin"
-    if os.path.exists(bin_path):
+    if _cache_fresh(bin_path, prefix + ".label"):
         ids = np.fromfile(bin_path, dtype=np.int32, count=num_nodes)
         assert ids.size == num_nodes, "label.bin size mismatch"
         ids = ids.astype(np.int64)
     else:
         ids = np.loadtxt(prefix + ".label", dtype=np.int64).reshape(-1)
         assert ids.shape[0] == num_nodes
-        ids.astype(np.int32).tofile(bin_path)
+        _atomic_tofile(ids.astype(np.int32), bin_path)
     assert ids.min() >= 0 and ids.max() < num_classes
     return ids
 

@@ -142,14 +142,9 @@ class Partition:
 
     def pad_nodes(self, x: np.ndarray, fill=0) -> np.ndarray:
         """[N, ...] node array -> [P*S, ...] padded (shard-major) array."""
-        out_shape = (self.num_parts * self.shard_nodes,) + x.shape[1:]
-        out = np.full(out_shape, fill, dtype=x.dtype)
-        for p in range(self.num_parts):
-            lo, hi = self.bounds[p]
-            n = hi - lo + 1
-            if n > 0:
-                out[p * self.shard_nodes: p * self.shard_nodes + n] = x[lo: hi + 1]
-        return out
+        return np.concatenate(
+            [self.pad_part(x, p, fill) for p in range(self.num_parts)],
+            axis=0)
 
     def pad_part(self, x: np.ndarray, p: int, fill=0,
                  dtype=None) -> np.ndarray:

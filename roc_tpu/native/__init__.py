@@ -45,8 +45,16 @@ def lib() -> Optional[ctypes.CDLL]:
         return None
     try:
         L = ctypes.CDLL(_SO)
-    except OSError:
+        _bind(L)
+    except (OSError, AttributeError):
+        # unloadable or STALE library (a symbol this version binds is
+        # missing and the rebuild failed) — degrade to the NumPy paths
         return None
+    _lib = L
+    return _lib
+
+
+def _bind(L: ctypes.CDLL) -> None:
     u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
     u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -78,8 +86,6 @@ def lib() -> Optional[ctypes.CDLL]:
                                       ctypes.c_int64, ctypes.c_int64,
                                       i32p, i32p, i32p, i32p]
     L.roc_chunk_plan_fill.restype = ctypes.c_int64
-    _lib = L
-    return _lib
 
 
 def available() -> bool:

@@ -135,17 +135,18 @@ def _squeeze_gd(gd: ShardedGraphData) -> ShardedGraphData:
 class SpmdTrainer(BaseTrainer):
     """Multi-chip trainer: same Trainer interface, mesh underneath."""
 
-    def _place_nodes(self, part_loader, spec: NamedSharding):
+    def _place_nodes(self, part_loader, spec: NamedSharding, row_shape=()):
         """Assemble a global node tensor from per-part host blocks, placing
         each part directly on its device.  Under `jax.distributed` each
-        process only loads/places the parts of its addressable devices."""
+        process only loads/places the parts of its addressable devices
+        (possibly none — row_shape supplies the trailing dims so the global
+        shape never depends on local shards existing)."""
         devices = list(self.mesh.devices.reshape(-1))
         pidx = jax.process_index()
         shards = [jax.device_put(part_loader(p), d)
                   for p, d in enumerate(devices) if d.process_index == pidx]
-        sample = shards[0]
         global_shape = (self.part.num_parts * self.part.shard_nodes,) \
-            + sample.shape[1:]
+            + tuple(row_shape)
         return jax.make_array_from_single_device_arrays(
             global_shape, spec, shards)
 
@@ -167,14 +168,15 @@ class SpmdTrainer(BaseTrainer):
         self.x = self._place_nodes(
             lambda p: self.part.pad_part(ds.features, p,
                                          dtype=np.dtype(self.dtype)),
-            node_spec)
+            node_spec, row_shape=ds.features.shape[1:])
         from roc_tpu.graph.lux import one_hot
 
         def onehot_part(p):
             # pad rows carry label 0; harmless — their mask is NONE
             ids = self.part.pad_part(ds.label_ids, p, fill=0)
             return one_hot(ids, ds.num_classes)
-        self.labels = self._place_nodes(onehot_part, node_spec)
+        self.labels = self._place_nodes(onehot_part, node_spec,
+                                        row_shape=(ds.num_classes,))
         # Pad rows get MASK_NONE so they never count in loss or metrics.
         self.mask = self._place_nodes(
             lambda p: self.part.pad_part(ds.mask, p, fill=MASK_NONE,
@@ -230,5 +232,14 @@ class SpmdTrainer(BaseTrainer):
             m = ops.perf_metrics(logits, labels, mask)
             return jax.tree.map(lambda v: jax.lax.psum(v, PARTS_AXIS), m)
 
+        @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
+                 in_specs=(P(), P(PARTS_AXIS), gd_specs),
+                 out_specs=P(PARTS_AXIS))
+        def logits_shard(params, x, gd):
+            gd = _squeeze_gd(gd)
+            gctx = _shard_gctx(gd, S, use_halo)
+            return model.apply(params, x, gctx, train=False)
+
         self._train_step = jax.jit(step_shard, donate_argnums=(0, 1))
         self._eval_step = jax.jit(eval_shard)
+        self._logits_step = jax.jit(logits_shard)

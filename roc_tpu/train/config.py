@@ -52,6 +52,7 @@ class Config:
     lazy_load: bool = False       # memmap features / defer one-hot labels
                                   # (sharded host loading for huge graphs)
     halo: bool = True             # v1 halo exchange vs v0 all_gather
+    check_sharding: bool = False  # validate sharded == single-device first
     profile_dir: str = ""         # write a jax.profiler trace of epochs 3-5
     multihost: bool = False       # jax.distributed.initialize() before run
 
@@ -87,6 +88,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-bf16", dest="use_bf16", action="store_true")
     p.add_argument("-lazy", dest="lazy_load", action="store_true")
     p.add_argument("-no-halo", dest="halo", action="store_false")
+    p.add_argument("-check-sharding", dest="check_sharding",
+                   action="store_true")
     p.add_argument("-profile", dest="profile_dir", default="")
     p.add_argument("-multihost", action="store_true")
     ns = p.parse_args(argv)

@@ -162,6 +162,10 @@ class BaseTrainer:
         return self._eval_step(self.params, self.x, self.labels, self.mask,
                                self.gdata)
 
+    def predict_logits(self):
+        """Inference logits for every (padded, for SPMD) node row."""
+        return self._logits_step(self.params, self.x, self.gdata)
+
     def run_epoch(self):
         cfg = self.config
         if self.epoch != 0 and self.epoch % cfg.decay_steps == 0:
@@ -256,5 +260,10 @@ class Trainer(BaseTrainer):
             logits = model.apply(params, x, gctx, train=False)
             return ops.perf_metrics(logits, labels, mask)
 
+        @jax.jit
+        def logits_step(params, x, gdata):
+            return model.apply(params, x, make_gctx(gdata, n), train=False)
+
         self._train_step = train_step
         self._eval_step = eval_step
+        self._logits_step = logits_step

@@ -1,0 +1,56 @@
+"""Shard-consistency checker + predict API tests."""
+
+import numpy as np
+import pytest
+
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gcn
+from roc_tpu.parallel.check import check_shard_consistency, predict_classes
+from roc_tpu.parallel.spmd import SpmdTrainer
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return datasets.synthetic("t", 200, 4.0, 8, 4, n_train=40, n_val=40,
+                              n_test=40, seed=21)
+
+
+def test_checker_passes_on_healthy_setup(ds):
+    cfg = Config(layers=[ds.in_dim, 8, ds.num_classes], num_parts=4,
+                 dropout_rate=0.0, eval_every=10**9)
+    m1, mp = check_shard_consistency(cfg, ds, build_gcn(cfg.layers, 0.0))
+    assert int(m1.train_all) == int(mp.train_all) == 40
+
+
+def test_checker_catches_a_plan_bug(ds, monkeypatch):
+    # sabotage the halo maps: swap two send rows — the checker must notice
+    from roc_tpu.parallel import halo as halo_mod
+    real = halo_mod.build_halo_maps
+
+    def broken(part):
+        maps = real(part)
+        send = maps.send_idx.copy()
+        if send.shape[-1] > 1:
+            send[..., [0, 1]] = send[..., [1, 0]]  # reorder within pairs
+            send[0, 1, 0] = 0                      # and corrupt one entry
+        return halo_mod.HaloMaps(maps.K, send, maps.edge_src_local,
+                                 maps.halo_rows_total)
+    monkeypatch.setattr("roc_tpu.parallel.spmd.build_halo_maps", broken)
+    cfg = Config(layers=[ds.in_dim, 8, ds.num_classes], num_parts=4,
+                 dropout_rate=0.0, eval_every=10**9, halo=True)
+    with pytest.raises(AssertionError, match="shard-consistency"):
+        check_shard_consistency(cfg, ds, build_gcn(cfg.layers, 0.0))
+
+
+def test_predict_classes_sharded_equals_single(ds):
+    layers = [ds.in_dim, 8, ds.num_classes]
+    cfg1 = Config(layers=layers, dropout_rate=0.0, eval_every=10**9)
+    cfgP = Config(layers=layers, dropout_rate=0.0, eval_every=10**9,
+                  num_parts=4)
+    t1 = Trainer(cfg1, ds, build_gcn(layers, 0.0))
+    tp = SpmdTrainer(cfgP, ds, build_gcn(layers, 0.0))
+    p1, pp = predict_classes(t1), predict_classes(tp)
+    assert p1.shape == pp.shape == (ds.graph.num_nodes,)
+    np.testing.assert_array_equal(p1, pp)

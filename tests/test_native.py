@@ -114,7 +114,7 @@ def test_in_degrees(built, ds):
 
 
 @pytest.mark.parametrize("shape", [(500, 9000), (64, 0), (40, 1000),
-                                   (1, 17)])
+                                   (1, 17), (8, 5000)])
 def test_chunk_plan_native_equals_numpy(built, shape):
     # native builder vs the vectorized-NumPy oracle in build_chunk_plan
     from roc_tpu.ops.pallas.segment_sum import build_chunk_plan
