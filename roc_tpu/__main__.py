@@ -30,6 +30,16 @@ def main(argv=None) -> int:
         print("error: -layers is required (e.g. -layers 1433-16-7)",
               file=sys.stderr)
         return 2
+    if cfg.perhost_load and (cfg.num_parts < 2 or not cfg.filename):
+        print("error: -perhost requires -file and -parts > 1",
+              file=sys.stderr)
+        return 2
+    if cfg.perhost_load and cfg.check_sharding:
+        # the checker's single-device reference needs the whole graph on one
+        # host — the opposite of what -perhost promises
+        print("error: -check-sharding needs the full graph on one host; "
+              "run it without -perhost", file=sys.stderr)
+        return 2
     # Config banner, mirroring gnn.cc:48-60.
     print("        ===== GNN settings =====", file=sys.stderr)
     print(f"        dataset = {cfg.filename or cfg.dataset} seed = {cfg.seed}\n"
@@ -41,7 +51,8 @@ def main(argv=None) -> int:
 
     if cfg.filename:
         ds = datasets.load_roc_dataset(cfg.filename, cfg.layers[0],
-                                       cfg.layers[-1], lazy=cfg.lazy_load)
+                                       cfg.layers[-1], lazy=cfg.lazy_load,
+                                       graph_stub=cfg.perhost_load)
     elif cfg.dataset:
         ds = datasets.get(cfg.dataset, seed=cfg.seed)
         assert ds.in_dim == cfg.layers[0], (

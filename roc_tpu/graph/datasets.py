@@ -22,9 +22,19 @@ from roc_tpu.graph.csr import Csr, add_self_edges, from_edges
 
 
 @dataclasses.dataclass(frozen=True)
+class GraphStub:
+    """Graph header only (num_nodes/num_edges) — the per-host loading path
+    never materializes the topology on any single host; SpmdTrainer reads
+    per-part `.lux` slices itself (roc_tpu/graph/shard_load.py)."""
+    num_nodes: int
+    num_edges: int
+
+
+@dataclasses.dataclass(frozen=True)
 class Dataset:
     name: str
-    graph: Csr              # includes self-edges (the reference's input contract)
+    graph: Csr              # includes self-edges (the reference's input
+                            # contract); a GraphStub under -perhost
     features: np.ndarray    # [N, in_dim] float32 (may be a read-only memmap)
     labels: "np.ndarray | None"  # [N, C] one-hot float32, or None when lazy
     label_ids: np.ndarray   # [N] int64
@@ -41,7 +51,8 @@ class Dataset:
 
 
 def load_roc_dataset(prefix: str, in_dim: int, num_classes: int,
-                     name: str = "", lazy: bool = False) -> Dataset:
+                     name: str = "", lazy: bool = False,
+                     graph_stub: bool = False) -> Dataset:
     """Load a dataset laid out in the reference's on-disk format.
 
     ``in_dim``/``num_classes`` come from the layer spec exactly as in the
@@ -50,8 +61,14 @@ def load_roc_dataset(prefix: str, in_dim: int, num_classes: int,
     the sharded-host-loading mode: each host's per-part placement then reads
     only its own vertex ranges from disk (the TPU analog of the reference's
     per-partition `.lux` seeking, load_task.cu:231-243).
+    ``graph_stub=True`` (implies lazy) reads only the 12-byte `.lux` header:
+    the per-host trainer loads topology slices itself.
     """
-    g = lux.read_lux(prefix + lux.LUX_SUFFIX)
+    if graph_stub:
+        lazy = True
+        g = GraphStub(*lux.read_header(prefix + lux.LUX_SUFFIX))
+    else:
+        g = lux.read_lux(prefix + lux.LUX_SUFFIX)
     feats = lux.load_features(prefix, g.num_nodes, in_dim, mmap=lazy)
     ids = lux.load_label_ids(prefix, g.num_nodes, num_classes)
     mask = lux.load_mask(prefix, g.num_nodes)

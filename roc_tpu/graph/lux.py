@@ -35,18 +35,30 @@ _MASK_STRS = {v: k for k, v in _MASK_NAMES.items()}
 LUX_SUFFIX = ".add_self_edge.lux"
 
 
+def read_header(path: str) -> "tuple[int, int]":
+    """The 12-byte `.lux` header: (num_nodes, num_edges).  Single home for
+    the header layout + native-vs-NumPy fallback (read_lux, the graph-stub
+    dataset mode, and the per-host loader all go through here)."""
+    from roc_tpu import native
+    if native.available():
+        return native.lux_header(path)
+    with open(path, "rb") as f:
+        num_nodes = int(np.fromfile(f, dtype=np.uint32, count=1)[0])
+        num_edges = int(np.fromfile(f, dtype=np.uint64, count=1)[0])
+    return num_nodes, num_edges
+
+
 def read_lux(path: str) -> Csr:
     """Read a `.lux` graph file into an exclusive-prefix CSR (native C++
     reader when built, NumPy otherwise)."""
     from roc_tpu import native
+    num_nodes, num_edges = read_header(path)
     if native.available():
-        num_nodes, num_edges = native.lux_header(path)
         raw_rows, raw_cols = native.lux_read_slice(
             path, 0, num_nodes, 0, num_edges)
     else:
         with open(path, "rb") as f:
-            num_nodes = int(np.fromfile(f, dtype=np.uint32, count=1)[0])
-            num_edges = int(np.fromfile(f, dtype=np.uint64, count=1)[0])
+            f.seek(12)
             raw_rows = np.fromfile(f, dtype=np.uint64, count=num_nodes)
             assert raw_rows.shape[0] == num_nodes, "truncated .lux rows"
             raw_cols = np.fromfile(f, dtype=np.uint32, count=num_edges)
@@ -72,7 +84,15 @@ def write_lux(path: str, g: Csr) -> None:
 
 def _cache_fresh(bin_path: str, src_path: str) -> bool:
     """A binary sidecar cache is usable iff it exists and is no older than
-    its source text file (a regenerated source invalidates it, like make)."""
+    its source text file (a regenerated source invalidates it, like make).
+
+    Equal mtimes count as fresh.  Multihost note: on shared storage with
+    cross-host clock skew a just-written cache can still look stale to a
+    late process, in which case several processes may re-parse the text
+    source concurrently — wasteful but correct (the atomic write-then-rename
+    in _atomic_tofile means readers never see a torn file).  Hosts that want
+    to avoid the duplicated parse should pre-warm the cache once (any
+    single-process run) before launching the fleet."""
     if not os.path.exists(bin_path):
         return False
     if not os.path.exists(src_path):

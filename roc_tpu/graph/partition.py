@@ -43,9 +43,17 @@ _EDGE_ALIGN = 8
 
 
 def edge_balanced_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
+    """Greedy cut over a CSR graph (see :func:`bounds_from_row_ptr`)."""
+    return bounds_from_row_ptr(g.row_ptr, num_parts)
+
+
+def bounds_from_row_ptr(row_ptr: np.ndarray,
+                        num_parts: int) -> List[Tuple[int, int]]:
     """The reference's greedy cut (gnn.cc:806-829): accumulate in-degrees,
     cut when the running count *exceeds* ceil(E/P).  Returns inclusive
-    (lo, hi) vertex bounds per part.
+    (lo, hi) vertex bounds per part.  Needs only the exclusive-prefix row
+    pointer — the per-host loader calls this without ever reading edge
+    columns.
 
     The reference simply asserts it got exactly P parts (gnn.cc:829); that
     can fail for skewed graphs (a huge-degree vertex early eats several
@@ -54,22 +62,25 @@ def edge_balanced_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
     the partitioner totals P for any graph.
     """
     assert num_parts >= 1
-    if g.num_nodes == 0:
+    num_nodes = len(row_ptr) - 1
+    num_edges = int(row_ptr[-1])
+    if num_nodes == 0:
         return [(0, -1)] * num_parts
     from roc_tpu import native
     if native.available():
-        n, nb = native.partition(g.row_ptr[1:], g.num_edges, num_parts)
+        n, nb = native.partition(np.ascontiguousarray(row_ptr[1:], np.uint64),
+                                 num_edges, num_parts)
         if n > num_parts:
             # C side dropped the overflow parts; fall back to the Python
             # scan whose full result the repair loops below can merge.
-            bounds = _python_bounds(g, num_parts)
+            bounds = _python_bounds(row_ptr, num_parts)
         else:
             bounds = [tuple(b) for b in nb[:n]]
     else:
-        bounds = _python_bounds(g, num_parts)
+        bounds = _python_bounds(row_ptr, num_parts)
     # Repair (reference would assert instead):
     while len(bounds) > num_parts:  # merge the two lightest neighbors
-        w = [int(g.row_ptr[hi + 1] - g.row_ptr[lo]) for lo, hi in bounds]
+        w = [int(row_ptr[hi + 1] - row_ptr[lo]) for lo, hi in bounds]
         i = int(np.argmin([w[j] + w[j + 1] for j in range(len(bounds) - 1)]))
         bounds[i] = (bounds[i][0], bounds[i + 1][1])
         del bounds[i + 1]
@@ -78,7 +89,7 @@ def edge_balanced_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
         i = int(np.argmax(sizes))
         lo, hi = bounds[i]
         if hi <= lo:  # cannot split single-vertex parts further: emit empties
-            bounds.append((g.num_nodes, g.num_nodes - 1))
+            bounds.append((num_nodes, num_nodes - 1))
             continue
         mid = (lo + hi) // 2
         bounds[i] = (lo, mid)
@@ -86,20 +97,23 @@ def edge_balanced_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
     return bounds
 
 
-def _python_bounds(g: Csr, num_parts: int) -> List[Tuple[int, int]]:
+def _python_bounds(row_ptr: np.ndarray,
+                   num_parts: int) -> List[Tuple[int, int]]:
     """Pure-NumPy greedy cut (oracle for the native implementation)."""
-    deg = np.diff(g.row_ptr)
-    edge_cap = (g.num_edges + num_parts - 1) // num_parts
+    deg = np.diff(row_ptr)
+    num_nodes = len(row_ptr) - 1
+    num_edges = int(row_ptr[-1])
+    edge_cap = (num_edges + num_parts - 1) // num_parts
     bounds: List[Tuple[int, int]] = []
     left, cnt = 0, 0
-    for v in range(g.num_nodes):
+    for v in range(num_nodes):
         cnt += int(deg[v])
         if cnt > edge_cap:
             bounds.append((left, v))
             cnt = 0
             left = v + 1
-    if cnt > 0 or left < g.num_nodes:
-        bounds.append((left, g.num_nodes - 1))
+    if cnt > 0 or left < num_nodes:
+        bounds.append((left, num_nodes - 1))
     return bounds
 
 
@@ -108,17 +122,18 @@ def _round_up(x: int, align: int) -> int:
 
 
 @dataclasses.dataclass(frozen=True)
-class Partition:
-    """Device-ready padded shard layout for a partitioned graph.
+class PartitionMeta:
+    """Partition geometry: everything global about the shard layout that is
+    O(P) to store — vertex bounds, padded shapes, live counts — plus the
+    global↔padded vertex-id mapping.  The per-host loader
+    (roc_tpu/graph/shard_load.py) broadcasts exactly this and builds edge
+    arrays only for its local parts; :class:`Partition` extends it with the
+    full per-part arrays for the single-host path.
 
-    Array shapes (P parts, S padded nodes/shard, E padded edges/shard):
       bounds          [P, 2]  inclusive global vertex range per part
       num_valid       [P]     live nodes per shard
       num_edges_valid [P]     live edges per shard
-      edge_src        [P, E]  per-edge source as *padded global* id in [0, P*S)
-      edge_dst        [P, E]  per-edge dest as *local* row in [0, S), ascending
-      in_degree       [P, S]  float32 in-degrees, 1.0 on pad rows
-      node_mask       [P, S]  bool, True on live rows
+      edge_starts     [P]     global edge offset of each part's first edge
     """
 
     num_parts: int
@@ -129,10 +144,7 @@ class Partition:
     bounds: np.ndarray
     num_valid: np.ndarray
     num_edges_valid: np.ndarray
-    edge_src: np.ndarray
-    edge_dst: np.ndarray
-    in_degree: np.ndarray
-    node_mask: np.ndarray
+    edge_starts: np.ndarray
 
     # -- vertex id mapping ------------------------------------------------
     def to_padded(self, v: np.ndarray) -> np.ndarray:
@@ -169,22 +181,63 @@ class Partition:
         return np.concatenate(parts, axis=0)
 
 
-def partition_graph(g: Csr, num_parts: int) -> Partition:
-    """Partition + pad a CSR into the static shard layout described above."""
-    g.validate()
-    bounds_list = edge_balanced_bounds(g, num_parts)
-    bounds = np.asarray(bounds_list, dtype=np.int64)
+@dataclasses.dataclass(frozen=True)
+class Partition(PartitionMeta):
+    """Device-ready padded shard layout for a partitioned graph: the meta
+    geometry plus full per-part arrays.
+
+    Array shapes (P parts, S padded nodes/shard, E padded edges/shard):
+      edge_src        [P, E]  per-edge source as *padded global* id in [0, P*S)
+      edge_dst        [P, E]  per-edge dest as *local* row in [0, S), ascending
+      in_degree       [P, S]  float32 in-degrees, 1.0 on pad rows
+      node_mask       [P, S]  bool, True on live rows
+    """
+
+    edge_src: np.ndarray = None
+    edge_dst: np.ndarray = None
+    in_degree: np.ndarray = None
+    node_mask: np.ndarray = None
+
+    @property
+    def meta(self) -> PartitionMeta:
+        return PartitionMeta(
+            num_parts=self.num_parts, shard_nodes=self.shard_nodes,
+            shard_edges=self.shard_edges, num_nodes=self.num_nodes,
+            num_edges=self.num_edges, bounds=self.bounds,
+            num_valid=self.num_valid, num_edges_valid=self.num_edges_valid,
+            edge_starts=self.edge_starts)
+
+
+def compute_meta(row_ptr: np.ndarray, num_parts: int) -> PartitionMeta:
+    """Partition geometry from the row pointer alone (no edge columns)."""
+    bounds = np.asarray(bounds_from_row_ptr(row_ptr, num_parts),
+                        dtype=np.int64)
     num_valid = np.maximum(bounds[:, 1] - bounds[:, 0] + 1, 0)
     # Always leave >=1 pad row per shard so pad edges have a zero source row
     # to point at even in the fullest shard.
     shard_nodes = _round_up(int(num_valid.max()) + 1, _NODE_ALIGN)
-
-    edge_lo = g.row_ptr[np.maximum(bounds[:, 0], 0)]
-    edge_hi = g.row_ptr[bounds[:, 1] + 1]
+    edge_lo = row_ptr[np.maximum(bounds[:, 0], 0)]
+    edge_hi = row_ptr[bounds[:, 1] + 1]
     num_edges_valid = np.where(num_valid > 0, edge_hi - edge_lo, 0)
-    shard_edges = max(_round_up(int(num_edges_valid.max()), _EDGE_ALIGN), _EDGE_ALIGN)
+    shard_edges = max(_round_up(int(num_edges_valid.max()), _EDGE_ALIGN),
+                      _EDGE_ALIGN)
+    return PartitionMeta(
+        num_parts=num_parts, shard_nodes=shard_nodes,
+        shard_edges=shard_edges, num_nodes=len(row_ptr) - 1,
+        num_edges=int(row_ptr[-1]), bounds=bounds,
+        num_valid=num_valid.astype(np.int64),
+        num_edges_valid=np.asarray(num_edges_valid, np.int64),
+        edge_starts=np.asarray(edge_lo, np.int64))
 
-    P, S, E = num_parts, shard_nodes, shard_edges
+
+def partition_graph(g: Csr, num_parts: int) -> Partition:
+    """Partition + pad a CSR into the static shard layout described above."""
+    g.validate()
+    meta = compute_meta(g.row_ptr, num_parts)
+    bounds = meta.bounds
+    num_valid = meta.num_valid
+    num_edges_valid = meta.num_edges_valid
+    P, S, E = num_parts, meta.shard_nodes, meta.shard_edges
     # Precompute the global->padded permutation for edge source remapping.
     part_of = np.zeros(g.num_nodes, dtype=np.int64)
     local_of = np.zeros(g.num_nodes, dtype=np.int64)
@@ -226,10 +279,8 @@ def partition_graph(g: Csr, num_parts: int) -> Partition:
             node_mask[p, :n] = True
 
     return Partition(
-        num_parts=P, shard_nodes=S, shard_edges=E,
-        num_nodes=g.num_nodes, num_edges=g.num_edges,
-        bounds=bounds, num_valid=num_valid.astype(np.int64),
-        num_edges_valid=np.asarray(num_edges_valid, dtype=np.int64),
+        **{f.name: getattr(meta, f.name)
+           for f in dataclasses.fields(PartitionMeta)},
         edge_src=edge_src, edge_dst=edge_dst,
         in_degree=in_degree, node_mask=node_mask,
     )

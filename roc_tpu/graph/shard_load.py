@@ -1,0 +1,280 @@
+"""Per-host (pod-scale) partition loading.
+
+Reference analog: each Legion point task seeks only its partition's byte
+ranges of the `.lux` file (load_task.cu:201-245) — no node ever holds the
+whole topology.  Round-1 of this framework regressed that: every host read
+the full graph and built all P parts.  This module restores per-host cost:
+
+  * process 0 reads ONLY the row-offset section (8 bytes/vertex), runs the
+    greedy edge-balanced cut, and broadcasts the packed O(P) geometry
+    (:class:`roc_tpu.graph.partition.PartitionMeta`);
+  * every process then reads only its local parts' row/column slices
+    (native `roc_lux_read_slice` when built, seek+fromfile otherwise) and
+    builds only local shards' padded edge arrays;
+  * halo maps need remote information (what each *other* shard's edges
+    reference of ours), so the row-index lists are exchanged host-side:
+    one allgather of an O(P^2) size matrix + one allgather of the padded
+    [L, P, K] need lists.  The exchange callable is injected — real runs
+    pass `jax.experimental.multihost_utils.process_allgather`, tests pass a
+    thread-barrier mock — and the outputs are bit-identical to the
+    single-host `build_halo_maps` path (asserted by tests/test_shard_load.py).
+
+Per-host peak memory: O(N/P + E/P) arrays + the O(P^2 K) halo exchange,
+vs O(N + E + P*E_shard) for the single-host path.  (Process 0 additionally
+holds the O(N) row pointer transiently during the cut.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+from roc_tpu.graph.csr import E_DTYPE, V_DTYPE
+from roc_tpu.graph.partition import PartitionMeta, compute_meta
+
+# allgather(x: np.ndarray) -> np.ndarray of shape [num_processes, *x.shape],
+# process-major in process-index order.  multihost_utils.process_allgather
+# has exactly this contract.
+AllGather = Callable[[np.ndarray], np.ndarray]
+
+
+def single_process_allgather(x: np.ndarray) -> np.ndarray:
+    return np.asarray(x)[None]
+
+
+def jax_allgather() -> AllGather:
+    """process_allgather with an int64-safe detour.
+
+    Without jax_enable_x64 (this repo never enables it), jax canonicalizes
+    int64 inputs to int32 — which would silently wrap num_edges/edge_starts
+    past 2^31 edges, i.e. at exactly the pod scale this loader exists for.
+    int64 arrays are split into two uint32 planes (which canonicalization
+    leaves alone) and reassembled after the gather."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    def ag(x):
+        x = np.asarray(x)
+        if x.dtype == np.int64 and not jax.config.jax_enable_x64:
+            hi = (x >> 32).astype(np.uint32)          # arithmetic shift
+            lo = (x & 0xFFFFFFFF).astype(np.uint32)
+            g = np.asarray(multihost_utils.process_allgather(
+                np.stack([hi, lo], axis=-1)))
+            ghi = g[..., 0].astype(np.int64)
+            ghi -= (ghi >> 31) << 32                  # re-sign the high word
+            return (ghi << 32) | g[..., 1].astype(np.int64)
+        return np.asarray(multihost_utils.process_allgather(x))
+
+    return ag
+
+
+_HEADER = 12  # uint32 numNodes + uint64 numEdges (gnn.h:33)
+
+
+def _read_rows_slice(path: str, num_nodes: int, lo: int, hi: int
+                     ) -> np.ndarray:
+    """raw_rows[lo:hi] (inclusive end offsets) via seek+read."""
+    from roc_tpu import native
+    if native.available():
+        rows, _ = native.lux_read_slice(path, lo, hi, 0, 0)
+        return rows
+    with open(path, "rb") as f:
+        f.seek(_HEADER + 8 * lo)
+        rows = np.fromfile(f, dtype=np.uint64, count=hi - lo)
+    assert rows.shape[0] == hi - lo, "truncated .lux rows"
+    return rows
+
+
+def _read_cols_slice(path: str, num_nodes: int, e0: int, e1: int
+                     ) -> np.ndarray:
+    """raw_cols[e0:e1] (source vertex ids) via seek+read."""
+    from roc_tpu import native
+    if native.available():
+        _, cols = native.lux_read_slice(path, 0, 0, e0, e1)
+        return cols
+    with open(path, "rb") as f:
+        f.seek(_HEADER + 8 * num_nodes + 4 * e0)
+        cols = np.fromfile(f, dtype=np.uint32, count=e1 - e0)
+    assert cols.shape[0] == e1 - e0, "truncated .lux cols"
+    return cols
+
+
+def _pack_meta(meta: PartitionMeta) -> np.ndarray:
+    return np.concatenate([
+        np.asarray([meta.num_parts, meta.shard_nodes, meta.shard_edges,
+                    meta.num_nodes, meta.num_edges], np.int64),
+        meta.bounds.reshape(-1).astype(np.int64),
+        meta.num_edges_valid.astype(np.int64),
+        meta.edge_starts.astype(np.int64),
+    ])
+
+
+def _unpack_meta(buf: np.ndarray) -> PartitionMeta:
+    P = int(buf[0])
+    bounds = buf[5:5 + 2 * P].reshape(P, 2).copy()
+    return PartitionMeta(
+        num_parts=P, shard_nodes=int(buf[1]), shard_edges=int(buf[2]),
+        num_nodes=int(buf[3]), num_edges=int(buf[4]), bounds=bounds,
+        num_valid=np.maximum(bounds[:, 1] - bounds[:, 0] + 1, 0),
+        num_edges_valid=buf[5 + 2 * P:5 + 3 * P].copy(),
+        edge_starts=buf[5 + 3 * P:5 + 4 * P].copy())
+
+
+def meta_from_lux(path: str, num_parts: int, process_index: int = 0,
+                  allgather: AllGather = single_process_allgather
+                  ) -> PartitionMeta:
+    """Compute (on process 0) and share the partition geometry.
+
+    Only process 0 pays the O(N) row-offset read + greedy cut; everyone else
+    receives the packed O(P) result through the allgather (a broadcast is
+    just an allgather we read row 0 of — keeps the injected-exchange surface
+    to one primitive)."""
+    if process_index == 0:
+        from roc_tpu.graph import lux
+        num_nodes, num_edges = lux.read_header(path)
+        raw_rows = _read_rows_slice(path, num_nodes, 0, num_nodes)
+        row_ptr = np.zeros(num_nodes + 1, dtype=E_DTYPE)
+        row_ptr[1:] = raw_rows.astype(E_DTYPE)
+        assert np.all(np.diff(row_ptr) >= 0), "non-monotone .lux offsets"
+        meta = compute_meta(row_ptr, num_parts)
+        packed = _pack_meta(meta)
+    else:
+        packed = np.zeros(5 + 4 * num_parts, np.int64)
+    shared = allgather(packed)[0]
+    return _unpack_meta(shared)
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalShards:
+    """Edge arrays for this process's parts only (L = len(part_ids) rows,
+    same per-row layout/padding rules as :class:`Partition`'s arrays —
+    tests assert bit-equality against the single-host builder)."""
+    part_ids: tuple
+    edge_src: np.ndarray   # [L, E] padded-global source ids
+    edge_dst: np.ndarray   # [L, E] local dest rows, ascending
+    in_degree: np.ndarray  # [L, S] float32, 1.0 on pad rows
+    node_mask: np.ndarray  # [L, S] bool
+
+    def nbytes(self) -> int:
+        return (self.edge_src.nbytes + self.edge_dst.nbytes
+                + self.in_degree.nbytes + self.node_mask.nbytes)
+
+
+def load_local_shards(path: str, meta: PartitionMeta,
+                      part_ids: Sequence[int]) -> LocalShards:
+    """Build the padded edge arrays for `part_ids` reading only those parts'
+    `.lux` byte ranges (the reference's per-partition seek,
+    load_task.cu:231-243)."""
+    L = len(part_ids)
+    P, S, E = meta.num_parts, meta.shard_nodes, meta.shard_edges
+    edge_src = np.zeros((L, E), dtype=E_DTYPE)
+    edge_dst = np.zeros((L, E), dtype=V_DTYPE)
+    in_degree = np.ones((L, S), dtype=np.float32)
+    node_mask = np.zeros((L, S), dtype=bool)
+    uppers = meta.bounds[:, 1]
+    for i, p in enumerate(part_ids):
+        lo, hi = meta.bounds[p]
+        n = int(meta.num_valid[p])
+        ne = int(meta.num_edges_valid[p])
+        if n > 0:
+            e0 = int(meta.edge_starts[p])
+            # local row offsets -> per-vertex degrees for vertices lo..hi
+            ends = _read_rows_slice(path, meta.num_nodes, lo,
+                                    hi + 1).astype(np.int64)
+            deg = np.diff(np.concatenate([[e0], ends]))
+            in_degree[i, :n] = deg.astype(np.float32)
+            node_mask[i, :n] = True
+            if ne > 0:
+                src_global = _read_cols_slice(path, meta.num_nodes, e0,
+                                              e0 + ne).astype(np.int64)
+                owner = np.searchsorted(uppers, src_global, side="left")
+                edge_src[i, :ne] = (owner * S + src_global
+                                    - meta.bounds[owner, 0]).astype(E_DTYPE)
+                # dst of edge e = vertex whose CSR range contains e
+                edge_dst[i, :ne] = np.repeat(
+                    np.arange(n, dtype=np.int64), deg).astype(V_DTYPE)
+        # pad edges (and whole rows of empty parts): source = this shard's
+        # first pad row (zero features), dst = last pad row, keeping
+        # edge_dst ascending — identical rules to partition_graph
+        edge_src[i, ne:] = p * S + n
+        edge_dst[i, ne:] = S - 1
+    return LocalShards(part_ids=tuple(part_ids), edge_src=edge_src,
+                       edge_dst=edge_dst, in_degree=in_degree,
+                       node_mask=node_mask)
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalHalo:
+    """This process's rows of the halo maps (cf. parallel/halo.py HaloMaps:
+    same K / same contents, restricted to part_ids)."""
+    K: int
+    part_ids: tuple
+    send_idx: np.ndarray        # [L, P, K] int32
+    edge_src_local: np.ndarray  # [L, E] int32 into [S local ++ P*K recv]
+    halo_rows_total: int
+
+
+def build_halo_local(meta: PartitionMeta, local: LocalShards,
+                     allgather: AllGather = single_process_allgather
+                     ) -> LocalHalo:
+    """Halo maps for local parts via a host-side index exchange.
+
+    Each process knows what its parts *receive* (their edges' remote
+    sources); what a part must *send* lives in other processes' edges, so
+    the per-(dest, owner) sorted-unique row lists are allgathered: first the
+    O(P^2) size matrix (fixes the global pad width K), then the padded
+    [L, P, K] need lists.  send_idx is the transpose of the assembled need
+    tensor — exactly `build_halo_maps`'s send_lists, built without any
+    process reading another's edges."""
+    part_ids = local.part_ids
+    L, P, S = len(part_ids), meta.num_parts, meta.shard_nodes
+    need: List[dict] = []   # per local part: {owner q: sorted unique locals}
+    sizes = np.zeros((P, P), np.int64)   # [dest p, owner q]
+    for i, p in enumerate(part_ids):
+        src = local.edge_src[i]
+        owner = src // S
+        remote = owner != p
+        per_owner = {}
+        for q in np.unique(owner[remote]):
+            locals_q = np.unique(src[remote & (owner == q)] - q * S)
+            per_owner[int(q)] = locals_q
+            sizes[p, int(q)] = len(locals_q)
+        need.append(per_owner)
+
+    all_sizes = allgather(sizes).sum(axis=0)   # disjoint rows: sum = union
+    K = max(int(all_sizes.max()), 1)
+    halo_total = int(all_sizes.sum())
+
+    # Pad value S-1 is a guaranteed pad row (partition.py keeps >=1 pad row
+    # per shard) whose features are zero.
+    my_need = np.full((L, P, K), S - 1, dtype=np.int32)
+    for i in range(L):
+        for q, rows in need[i].items():
+            my_need[i, q, :len(rows)] = rows
+    gathered = allgather(my_need)               # [nproc, L, P, K]
+    assert gathered.shape[0] * L == P, (
+        "uneven parts per process: per-host loading needs P divisible by "
+        "process count")
+    full_need = gathered.reshape(P, P, K)       # [dest p, owner q, K]
+    # Process-major order must equal part order (asserted by caller wiring).
+    send_full = full_need.transpose(1, 0, 2)    # [owner q, dest p, K]
+    send_idx = np.ascontiguousarray(send_full[list(part_ids)])
+
+    edge_src_local = np.empty((L, meta.shard_edges), dtype=np.int32)
+    for i, p in enumerate(part_ids):
+        src = local.edge_src[i]
+        owner = (src // S).astype(np.int64)
+        local_row = (src - owner * S).astype(np.int64)
+        out = np.empty(meta.shard_edges, dtype=np.int64)
+        own = owner == p
+        out[own] = local_row[own]
+        for q, rows in need[i].items():
+            sel = owner == q
+            pos = np.searchsorted(rows, local_row[sel])
+            out[sel] = S + q * K + pos
+        edge_src_local[i] = out
+    return LocalHalo(K=K, part_ids=part_ids, send_idx=send_idx,
+                     edge_src_local=edge_src_local,
+                     halo_rows_total=halo_total)

@@ -176,6 +176,12 @@ def chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int):
     assert tuple(geo) == (VB, EB, CPAD), (
         f"native plan geometry {tuple(geo)} != python ({VB}, {EB}, {CPAD}); "
         f"rebuild roc_tpu/native after changing segment_sum constants")
+    # The native plan is int32 throughout; a silent wrap past 2^31 would
+    # corrupt the schedule (the pure-NumPy path asserts the same bounds).
+    assert num_rows < 2**31, f"num_rows {num_rows} overflows int32 plan"
+    for name, arr in (("edge_src", edge_src), ("edge_dst", edge_dst)):
+        assert len(arr) == 0 or int(np.max(arr)) < 2**31, \
+            f"{name} ids overflow int32 plan"
     src = np.ascontiguousarray(edge_src, np.int32)
     dst = np.ascontiguousarray(edge_dst, np.int32)
     E = len(src)

@@ -130,6 +130,14 @@ def build_aggregate_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
     bwd = build_chunk_plan(np.asarray(edge_dst)[order].astype(np.int32),
                            np.asarray(edge_src)[order].astype(np.int32),
                            table_rows)
+    # _one_hot_dots relies on consecutive obi increasing by at most 1 (every
+    # window, even an empty one, gets >= 1 chunk) so that within a scan step
+    # lw = ob - ob[0] < CB; a plan builder that skipped empty windows would
+    # silently drop contributions there.  Pin the invariant here, where every
+    # plan (python or native) passes through.
+    for plan in (fwd, bwd):
+        assert np.all(np.diff(np.asarray(plan.obi)) <= 1), \
+            "chunk plan skips output windows (obi jump > 1)"
     return AggregatePlans(
         fwd_obi=jnp.asarray(fwd.obi), fwd_first=jnp.asarray(fwd.first),
         fwd_edst=jnp.asarray(fwd.edst), fwd_esrc=jnp.asarray(fwd.esrc),
@@ -137,17 +145,23 @@ def build_aggregate_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
         bwd_edst=jnp.asarray(bwd.edst), bwd_esrc=jnp.asarray(bwd.esrc))
 
 
-def pad_plans(plans: "list[AggregatePlans]") -> AggregatePlans:
+def pad_plans(plans: "list[AggregatePlans]", min_fwd: int = 0,
+              min_bwd: int = 0) -> AggregatePlans:
     """Stack per-shard plans to common chunk counts (shard_map needs one
     static program).  Pad chunks are the canonical no-ops of
-    :func:`roc_tpu.ops.pallas.segment_sum.pad_chunks`."""
+    :func:`roc_tpu.ops.pallas.segment_sum.pad_chunks`.
+
+    ``min_fwd``/``min_bwd`` raise the target chunk counts — the per-host
+    loader passes the allgathered global maxima so every process compiles
+    the same program even though each only sees its local parts' plans."""
     from roc_tpu.ops.pallas.segment_sum import pad_chunks
 
     def stack(prefix):
         quads = [(getattr(p, prefix + "obi"), getattr(p, prefix + "first"),
                   getattr(p, prefix + "edst"), getattr(p, prefix + "esrc"))
                  for p in plans]
-        C = max(q[0].shape[0] for q in quads)
+        C = max(max(q[0].shape[0] for q in quads),
+                min_fwd if prefix == "fwd_" else min_bwd)
         padded = [pad_chunks(*q, C - q[0].shape[0], jnp) for q in quads]
         return [jnp.stack([p[i] for p in padded]) for i in range(4)]
 

@@ -150,12 +150,112 @@ class SpmdTrainer(BaseTrainer):
         return jax.make_array_from_single_device_arrays(
             global_shape, spec, shards)
 
+    def _local_part_ids(self):
+        """Parts whose devices this process owns.  The halo exchange and
+        plan-count allgather assume parts are process-major contiguous
+        (jax.devices() orders devices by process) — asserted here."""
+        devices = list(self.mesh.devices.reshape(-1))
+        pidx = jax.process_index()
+        ids = [p for p, d in enumerate(devices) if d.process_index == pidx]
+        L = len(devices) // jax.process_count()
+        assert ids == list(range(pidx * L, pidx * L + L)), (
+            f"non-contiguous local parts {ids}: mesh device order is not "
+            "process-major")
+        return ids
+
+    def _build_graph_full(self, backend: str) -> ShardedGraphData:
+        """Single-host path: whole graph in memory, all P parts built."""
+        cfg, ds = self.config, self.dataset
+        self.part = partition_graph(ds.graph, cfg.num_parts)
+        self.halo = build_halo_maps(self.part) if cfg.halo else None
+        return shard_graph(self.part, self.halo, backend)
+
+    def _build_graph_perhost(self, backend: str) -> ShardedGraphData:
+        """Pod-scale path: this process reads only its parts' `.lux` byte
+        ranges and builds only local rows of every [P, ...] array (see
+        roc_tpu/graph/shard_load.py).  Returned leaves have L rows; the
+        caller places them per device via _place_parts."""
+        from roc_tpu.graph import lux, shard_load
+        cfg = self.config
+        assert cfg.filename, "-perhost needs -file (an on-disk .lux dataset)"
+        path = cfg.filename + lux.LUX_SUFFIX
+        nproc = jax.process_count()
+        ag = shard_load.jax_allgather() if nproc > 1 \
+            else shard_load.single_process_allgather
+        meta = shard_load.meta_from_lux(path, cfg.num_parts,
+                                        jax.process_index(), ag)
+        self.part = meta
+        part_ids = self._local_part_ids()
+        local = shard_load.load_local_shards(path, meta, part_ids)
+        lhalo = shard_load.build_halo_local(meta, local, ag) if cfg.halo \
+            else None
+        self.halo = lhalo
+        P_, S = meta.num_parts, meta.shard_nodes
+        src = lhalo.edge_src_local if lhalo is not None else local.edge_src
+        plans = None
+        if backend in ("pallas", "matmul"):
+            table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
+            plan_list = [
+                ops.build_aggregate_plans(src[i], local.edge_dst[i], S,
+                                          table_rows)
+                for i in range(len(part_ids))]
+            counts = np.asarray([[p.fwd_obi.shape[0] for p in plan_list],
+                                 [p.bwd_obi.shape[0] for p in plan_list]],
+                                np.int64)
+            gmax = ag(counts.max(axis=1)).max(axis=0)
+            plans = ops.pad_plans(plan_list, min_fwd=int(gmax[0]),
+                                  min_bwd=int(gmax[1]))
+        return ShardedGraphData(
+            edge_src=jnp.asarray(src, jnp.int32),
+            edge_dst=jnp.asarray(local.edge_dst, jnp.int32),
+            in_degree=jnp.asarray(local.in_degree, jnp.float32),
+            send_idx=None if lhalo is None else jnp.asarray(lhalo.send_idx),
+            plans=plans,
+            backend=backend)
+
+    def _place_parts(self, gd: ShardedGraphData,
+                     spec: NamedSharding) -> ShardedGraphData:
+        """Assemble global [P, ...] graph arrays from per-part host blocks,
+        placing each part's block directly on its device (no host ever
+        holds a full array; the leading axis is the 'parts' axis)."""
+        devices = list(self.mesh.devices.reshape(-1))
+        part_ids = self._local_part_ids()
+        P_ = self.part.num_parts
+
+        def place(leaf):
+            arr = np.asarray(leaf)
+            local = arr if arr.shape[0] == len(part_ids) else arr[part_ids]
+            shards = [jax.device_put(local[i][None], devices[p])
+                      for i, p in enumerate(part_ids)]
+            return jax.make_array_from_single_device_arrays(
+                (P_,) + local.shape[1:], spec, shards)
+
+        return jax.tree.map(place, gd)
+
+    def _log_shard_stats(self):
+        """Aggregation skew report (SURVEY §7 hard part): every shard pays
+        the padded-max edge count, so the tax is E_pad/E_live - 1.  The
+        reference balances edges precisely because kernel work ∝ edges
+        (gnn.cc:806-829); here skew additionally becomes *padding*, the
+        scaling ceiling for skewed graphs."""
+        import sys
+        m = self.part
+        live = np.asarray(m.num_edges_valid, np.float64)
+        pad_tax = m.shard_edges * m.num_parts / max(live.sum(), 1.0) - 1.0
+        print(f"# shards: P={m.num_parts} S={m.shard_nodes} "
+              f"E={m.shard_edges} edges/shard min={int(live.min())} "
+              f"mean={int(live.mean())} max={int(live.max())} "
+              f"padded-max tax={pad_tax * 100:.1f}%", file=sys.stderr)
+
     def _setup(self):
         cfg, ds, model = self.config, self.dataset, self.model
         P_ = cfg.num_parts
-        self.part = partition_graph(ds.graph, P_)
-        self.halo = build_halo_maps(self.part) if cfg.halo else None
         self.mesh = make_mesh(P_)
+        backend = self._effective_backend()
+        gd = self._build_graph_perhost(backend) if cfg.perhost_load \
+            else self._build_graph_full(backend)
+        if cfg.verbose:
+            self._log_shard_stats()
         S = self.part.shard_nodes
 
         node_spec = NamedSharding(self.mesh, P(PARTS_AXIS))
@@ -182,10 +282,7 @@ class SpmdTrainer(BaseTrainer):
             lambda p: self.part.pad_part(ds.mask, p, fill=MASK_NONE,
                                          dtype=np.int32), node_spec)
 
-        backend = self._effective_backend()
-        gd = shard_graph(self.part, self.halo, backend)
-        self.gdata = jax.tree.map(  # None (no send_idx) passes through
-            lambda a: jax.device_put(a, node_spec), gd)
+        self.gdata = self._place_parts(gd, node_spec)
 
         self.params = jax.device_put(model.init_params(self.key), repl_spec)
         self.opt_state = jax.device_put(self.optimizer.init(self.params),

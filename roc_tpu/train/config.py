@@ -55,6 +55,8 @@ class Config:
     check_sharding: bool = False  # validate sharded == single-device first
     profile_dir: str = ""         # write a jax.profiler trace of epochs 3-5
     multihost: bool = False       # jax.distributed.initialize() before run
+    perhost_load: bool = False    # each process reads only its parts' .lux
+                                  # byte ranges (pod-scale; needs -file)
 
 
 def parse_args(argv: List[str]) -> Config:
@@ -92,6 +94,7 @@ def parse_args(argv: List[str]) -> Config:
                    action="store_true")
     p.add_argument("-profile", dest="profile_dir", default="")
     p.add_argument("-multihost", action="store_true")
+    p.add_argument("-perhost", dest="perhost_load", action="store_true")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

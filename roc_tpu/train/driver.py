@@ -221,8 +221,17 @@ class BaseTrainer:
     # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
     def save_checkpoint(self, path: str):
         from roc_tpu.train import checkpoint
-        checkpoint.save(path, self.params, self.opt_state, self.epoch,
-                        self.optimizer.alpha)
+        # Params/opt state are replicated: every process holds the same
+        # values, so only process 0 writes (P identical writers on shared
+        # storage would be redundant work + a last-writer race); the barrier
+        # keeps the others from racing ahead and e.g. resuming a checkpoint
+        # that is still mid-rename.
+        if jax.process_index() == 0:
+            checkpoint.save(path, self.params, self.opt_state, self.epoch,
+                            self.optimizer.alpha)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("roc_tpu_ckpt_saved")
 
     def restore(self, path: str):
         from roc_tpu.train import checkpoint

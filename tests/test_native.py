@@ -64,7 +64,7 @@ def test_partition_native_equals_python(built, ds):
     g = ds.graph
     for parts in (1, 2, 4, 7):
         n, nb = built.partition(g.row_ptr[1:], g.num_edges, parts)
-        py = _python_bounds(g, parts)
+        py = _python_bounds(g.row_ptr, parts)
         assert n == len(py)
         assert [tuple(b) for b in nb[:n][: len(py)]] == py[: min(n, parts)]
         # and the public API (whichever path it takes) stays self-consistent

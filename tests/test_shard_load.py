@@ -1,0 +1,139 @@
+"""Per-host partition loading (roc_tpu/graph/shard_load.py) must be
+bit-identical to the single-host path (partition_graph + build_halo_maps),
+while each simulated process touches only its own parts' arrays.
+
+The multi-process exchange is exercised with a thread-barrier allgather: N
+threads each run the full per-host pipeline (meta broadcast -> local slice
+reads -> halo exchange) concurrently, synchronizing exactly where real
+processes would hit `multihost_utils.process_allgather`.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from roc_tpu.graph import datasets, lux, shard_load
+from roc_tpu.graph.partition import partition_graph
+from roc_tpu.parallel.halo import build_halo_maps
+
+
+class ThreadAllGather:
+    """process_allgather lookalike for N threads in one process."""
+
+    def __init__(self, nproc):
+        self.nproc = nproc
+        self.barrier = threading.Barrier(nproc)
+        self.slots = [None] * nproc
+
+    def for_process(self, i):
+        def allgather(x):
+            self.slots[i] = np.asarray(x).copy()
+            self.barrier.wait()           # all slots filled
+            out = np.stack(self.slots)
+            self.barrier.wait()           # all readers done before reuse
+            return out
+        return allgather
+
+
+@pytest.fixture(scope="module")
+def roc_dir(tmp_path_factory):
+    ds = datasets.synthetic("shardload", 600, 6.0, 12, 5,
+                            n_train=100, n_val=100, n_test=100, seed=7)
+    prefix = str(tmp_path_factory.mktemp("roc") / "g")
+    lux.write_dataset(prefix, ds.graph, ds.features, ds.label_ids, ds.mask)
+    return prefix, ds
+
+
+def _run_threads(nproc, fn):
+    """Run fn(proc_index) in nproc threads; propagate exceptions."""
+    results, errors = [None] * nproc, []
+
+    def wrap(i):
+        try:
+            results[i] = fn(i)
+        except BaseException as e:  # noqa: BLE001 - rethrown below
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,), daemon=True)
+               for i in range(nproc)]
+    [t.start() for t in threads]
+    [t.join(timeout=60) for t in threads]
+    if errors:
+        raise errors[0]
+    return results
+
+
+@pytest.mark.parametrize("num_parts,nproc", [(8, 4), (8, 8), (4, 2), (6, 3)])
+def test_perhost_equals_singlehost(roc_dir, num_parts, nproc):
+    prefix, ds = roc_dir
+    path = prefix + lux.LUX_SUFFIX
+    # Ground truth: the single-host builders.
+    part = partition_graph(ds.graph, num_parts)
+    halo = build_halo_maps(part)
+
+    L = num_parts // nproc
+    ag = ThreadAllGather(nproc)
+
+    def per_process(i):
+        allg = ag.for_process(i)
+        meta = shard_load.meta_from_lux(path, num_parts, process_index=i,
+                                        allgather=allg)
+        part_ids = list(range(i * L, (i + 1) * L))
+        local = shard_load.load_local_shards(path, meta, part_ids)
+        lhalo = shard_load.build_halo_local(meta, local, allgather=allg)
+        return meta, local, lhalo
+
+    results = _run_threads(nproc, per_process)
+
+    for i, (meta, local, lhalo) in enumerate(results):
+        # geometry identical on every process
+        np.testing.assert_array_equal(meta.bounds, part.bounds)
+        assert (meta.shard_nodes, meta.shard_edges) == \
+            (part.shard_nodes, part.shard_edges)
+        np.testing.assert_array_equal(meta.num_edges_valid,
+                                      part.num_edges_valid)
+        # local shard arrays == the global builder's rows for those parts
+        ids = list(local.part_ids)
+        np.testing.assert_array_equal(local.edge_src, part.edge_src[ids])
+        np.testing.assert_array_equal(local.edge_dst, part.edge_dst[ids])
+        np.testing.assert_array_equal(local.in_degree, part.in_degree[ids])
+        np.testing.assert_array_equal(local.node_mask, part.node_mask[ids])
+        # halo maps == the global builder's rows
+        assert lhalo.K == halo.K
+        assert lhalo.halo_rows_total == halo.halo_rows_total
+        np.testing.assert_array_equal(lhalo.send_idx, halo.send_idx[ids])
+        np.testing.assert_array_equal(lhalo.edge_src_local,
+                                      halo.edge_src_local[ids])
+        # per-host memory: local arrays are exactly the L/P slice
+        global_bytes = (part.edge_src.nbytes + part.edge_dst.nbytes
+                        + part.in_degree.nbytes + part.node_mask.nbytes)
+        assert local.nbytes() == global_bytes * L // num_parts
+
+
+def test_jax_allgather_int64_safe():
+    """int64 values past 2^31 must survive the gather (jax canonicalizes
+    int64->int32 without x64 mode; shard_load splits into uint32 planes).
+    Single-process process_allgather still exercises the split/reassemble."""
+    ag = shard_load.jax_allgather()
+    x = np.array([3_200_000_000, -5, 0, 2**40 + 123, -(2**35)], np.int64)
+    out = ag(x)
+    assert out.dtype == np.int64
+    np.testing.assert_array_equal(out[0], x)
+    # non-int64 passes straight through
+    y = np.arange(6, dtype=np.int32).reshape(2, 3)
+    np.testing.assert_array_equal(ag(y)[0], y)
+
+
+def test_perhost_single_process_path(roc_dir):
+    """Default allgather (no mesh/threads) covers the 1-process fast path."""
+    prefix, ds = roc_dir
+    path = prefix + lux.LUX_SUFFIX
+    part = partition_graph(ds.graph, 4)
+    halo = build_halo_maps(part)
+    meta = shard_load.meta_from_lux(path, 4)
+    local = shard_load.load_local_shards(path, meta, range(4))
+    lhalo = shard_load.build_halo_local(meta, local)
+    np.testing.assert_array_equal(local.edge_src, part.edge_src)
+    np.testing.assert_array_equal(lhalo.edge_src_local, halo.edge_src_local)
+    np.testing.assert_array_equal(lhalo.send_idx, halo.send_idx)
