@@ -48,21 +48,43 @@ def read_header(path: str) -> "tuple[int, int]":
     return num_nodes, num_edges
 
 
+_HEADER_SIZE = 12  # uint32 numNodes + uint64 numEdges (gnn.h:33)
+
+
+def read_rows_slice(path: str, lo: int, hi: int) -> np.ndarray:
+    """raw_rows[lo:hi] (inclusive end offsets) via per-range seek+read (the
+    reference's per-partition seeking, load_task.cu:231-243)."""
+    from roc_tpu import native
+    if native.available():
+        rows, _ = native.lux_read_slice(path, lo, hi, 0, 0)
+        return rows
+    with open(path, "rb") as f:
+        f.seek(_HEADER_SIZE + 8 * lo)
+        rows = np.fromfile(f, dtype=np.uint64, count=hi - lo)
+    assert rows.shape[0] == hi - lo, "truncated .lux rows"
+    return rows
+
+
+def read_cols_slice(path: str, num_nodes: int, e0: int, e1: int
+                    ) -> np.ndarray:
+    """raw_cols[e0:e1] (source vertex ids) via per-range seek+read."""
+    from roc_tpu import native
+    if native.available():
+        _, cols = native.lux_read_slice(path, 0, 0, e0, e1)
+        return cols
+    with open(path, "rb") as f:
+        f.seek(_HEADER_SIZE + 8 * num_nodes + 4 * e0)
+        cols = np.fromfile(f, dtype=np.uint32, count=e1 - e0)
+    assert cols.shape[0] == e1 - e0, "truncated .lux cols"
+    return cols
+
+
 def read_lux(path: str) -> Csr:
     """Read a `.lux` graph file into an exclusive-prefix CSR (native C++
     reader when built, NumPy otherwise)."""
-    from roc_tpu import native
     num_nodes, num_edges = read_header(path)
-    if native.available():
-        raw_rows, raw_cols = native.lux_read_slice(
-            path, 0, num_nodes, 0, num_edges)
-    else:
-        with open(path, "rb") as f:
-            f.seek(12)
-            raw_rows = np.fromfile(f, dtype=np.uint64, count=num_nodes)
-            assert raw_rows.shape[0] == num_nodes, "truncated .lux rows"
-            raw_cols = np.fromfile(f, dtype=np.uint32, count=num_edges)
-            assert raw_cols.shape[0] == num_edges, "truncated .lux cols"
+    raw_rows = read_rows_slice(path, 0, num_nodes)
+    raw_cols = read_cols_slice(path, num_nodes, 0, num_edges)
     # Reference asserts monotonicity and the final offset (gnn.cc:797-800).
     assert np.all(np.diff(raw_rows.astype(np.int64)) >= 0)
     assert num_nodes == 0 or raw_rows[-1] == num_edges

@@ -193,10 +193,10 @@ class Partition(PartitionMeta):
       node_mask       [P, S]  bool, True on live rows
     """
 
-    edge_src: np.ndarray = None
-    edge_dst: np.ndarray = None
-    in_degree: np.ndarray = None
-    node_mask: np.ndarray = None
+    edge_src: np.ndarray
+    edge_dst: np.ndarray
+    in_degree: np.ndarray
+    node_mask: np.ndarray
 
     @property
     def meta(self) -> PartitionMeta:

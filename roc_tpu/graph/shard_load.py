@@ -70,37 +70,6 @@ def jax_allgather() -> AllGather:
     return ag
 
 
-_HEADER = 12  # uint32 numNodes + uint64 numEdges (gnn.h:33)
-
-
-def _read_rows_slice(path: str, num_nodes: int, lo: int, hi: int
-                     ) -> np.ndarray:
-    """raw_rows[lo:hi] (inclusive end offsets) via seek+read."""
-    from roc_tpu import native
-    if native.available():
-        rows, _ = native.lux_read_slice(path, lo, hi, 0, 0)
-        return rows
-    with open(path, "rb") as f:
-        f.seek(_HEADER + 8 * lo)
-        rows = np.fromfile(f, dtype=np.uint64, count=hi - lo)
-    assert rows.shape[0] == hi - lo, "truncated .lux rows"
-    return rows
-
-
-def _read_cols_slice(path: str, num_nodes: int, e0: int, e1: int
-                     ) -> np.ndarray:
-    """raw_cols[e0:e1] (source vertex ids) via seek+read."""
-    from roc_tpu import native
-    if native.available():
-        _, cols = native.lux_read_slice(path, 0, 0, e0, e1)
-        return cols
-    with open(path, "rb") as f:
-        f.seek(_HEADER + 8 * num_nodes + 4 * e0)
-        cols = np.fromfile(f, dtype=np.uint32, count=e1 - e0)
-    assert cols.shape[0] == e1 - e0, "truncated .lux cols"
-    return cols
-
-
 def _pack_meta(meta: PartitionMeta) -> np.ndarray:
     return np.concatenate([
         np.asarray([meta.num_parts, meta.shard_nodes, meta.shard_edges,
@@ -134,7 +103,7 @@ def meta_from_lux(path: str, num_parts: int, process_index: int = 0,
     if process_index == 0:
         from roc_tpu.graph import lux
         num_nodes, num_edges = lux.read_header(path)
-        raw_rows = _read_rows_slice(path, num_nodes, 0, num_nodes)
+        raw_rows = lux.read_rows_slice(path, 0, num_nodes)
         row_ptr = np.zeros(num_nodes + 1, dtype=E_DTYPE)
         row_ptr[1:] = raw_rows.astype(E_DTYPE)
         assert np.all(np.diff(row_ptr) >= 0), "non-monotone .lux offsets"
@@ -181,14 +150,15 @@ def load_local_shards(path: str, meta: PartitionMeta,
         if n > 0:
             e0 = int(meta.edge_starts[p])
             # local row offsets -> per-vertex degrees for vertices lo..hi
-            ends = _read_rows_slice(path, meta.num_nodes, lo,
-                                    hi + 1).astype(np.int64)
+            from roc_tpu.graph.lux import read_rows_slice
+            ends = read_rows_slice(path, lo, hi + 1).astype(np.int64)
             deg = np.diff(np.concatenate([[e0], ends]))
             in_degree[i, :n] = deg.astype(np.float32)
             node_mask[i, :n] = True
             if ne > 0:
-                src_global = _read_cols_slice(path, meta.num_nodes, e0,
-                                              e0 + ne).astype(np.int64)
+                from roc_tpu.graph.lux import read_cols_slice
+                src_global = read_cols_slice(path, meta.num_nodes, e0,
+                                             e0 + ne).astype(np.int64)
                 owner = np.searchsorted(uppers, src_global, side="left")
                 edge_src[i, :ne] = (owner * S + src_global
                                     - meta.bounds[owner, 0]).astype(E_DTYPE)

@@ -86,6 +86,10 @@ def _bind(L: ctypes.CDLL) -> None:
                                       ctypes.c_int64, ctypes.c_int64,
                                       i32p, i32p, i32p, i32p]
     L.roc_chunk_plan_fill.restype = ctypes.c_int64
+    L.roc_halo_sizes.argtypes = [i64p] + [ctypes.c_int64] * 3 + [i64p]
+    L.roc_halo_sizes.restype = ctypes.c_int
+    L.roc_halo_fill.argtypes = [i64p] + [ctypes.c_int64] * 4 + [i32p, i32p]
+    L.roc_halo_fill.restype = ctypes.c_int
 
 
 def available() -> bool:
@@ -159,6 +163,30 @@ def in_degrees(raw_rows: np.ndarray) -> np.ndarray:
     out = np.empty(len(raw_rows), np.float32)
     L.roc_in_degrees(raw_rows, len(raw_rows), out)
     return out
+
+
+def halo_maps(edge_src: np.ndarray, shard_nodes: int):
+    """Halo send lists + edge-source remap (see parallel/halo.py layout).
+
+    edge_src: [P, E] padded-global int64.  Returns (K, sizes [P, P] int64,
+    send_idx [P, P, K] int32, edge_src_local [P, E] int32)."""
+    L = lib()
+    assert L is not None
+    src = np.ascontiguousarray(edge_src, np.int64)
+    P, E = src.shape
+    sizes = np.zeros((P, P), np.int64)
+    rc = L.roc_halo_sizes(src.reshape(-1), P, E, shard_nodes,
+                          sizes.reshape(-1))
+    if rc != 0:
+        raise RuntimeError(f"roc_halo_sizes rc={rc}")
+    K = max(int(sizes.max()), 1)
+    send_idx = np.empty((P, P, K), np.int32)
+    edge_src_local = np.empty((P, E), np.int32)
+    rc = L.roc_halo_fill(src.reshape(-1), P, E, shard_nodes, K,
+                         send_idx.reshape(-1), edge_src_local.reshape(-1))
+    if rc != 0:
+        raise RuntimeError(f"roc_halo_fill rc={rc}")
+    return K, sizes, send_idx, edge_src_local
 
 
 def chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int):

@@ -239,6 +239,8 @@ class SpmdTrainer(BaseTrainer):
         (gnn.cc:806-829); here skew additionally becomes *padding*, the
         scaling ceiling for skewed graphs."""
         import sys
+        if jax.process_index() != 0:   # one banner per pod, not per host
+            return
         m = self.part
         live = np.asarray(m.num_edges_valid, np.float64)
         pad_tax = m.shard_edges * m.num_parts / max(live.sum(), 1.0) - 1.0

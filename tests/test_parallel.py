@@ -111,3 +111,19 @@ def test_halo_moves_fewer_rows_than_allgather():
     halo = build_halo_maps(part)
     full_rows = part.num_parts * part.shard_nodes * (part.num_parts - 1)
     assert halo.halo_rows_total < full_rows
+
+
+@pytest.mark.parametrize("parts", [2, 3, 4, 8])
+def test_fast_halo_builders_equal_reference(parts):
+    """The native and vectorized-NumPy builders must be bit-identical to
+    the original per-pair loop implementation (kept as the oracle)."""
+    from roc_tpu.parallel.halo import (_build_halo_maps_numpy,
+                                       _build_halo_maps_reference)
+    ds = small_ds()
+    part = partition_graph(ds.graph, parts)
+    ref = _build_halo_maps_reference(part)
+    for fast in (build_halo_maps(part), _build_halo_maps_numpy(part)):
+        assert fast.K == ref.K
+        assert fast.halo_rows_total == ref.halo_rows_total
+        np.testing.assert_array_equal(fast.send_idx, ref.send_idx)
+        np.testing.assert_array_equal(fast.edge_src_local, ref.edge_src_local)
