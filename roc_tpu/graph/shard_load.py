@@ -32,6 +32,7 @@ from typing import Callable, List, Sequence
 import numpy as np
 
 from roc_tpu.graph.csr import E_DTYPE, V_DTYPE
+from roc_tpu.graph.lux import read_cols_slice, read_header, read_rows_slice
 from roc_tpu.graph.partition import PartitionMeta, compute_meta
 
 # allgather(x: np.ndarray) -> np.ndarray of shape [num_processes, *x.shape],
@@ -101,9 +102,8 @@ def meta_from_lux(path: str, num_parts: int, process_index: int = 0,
     just an allgather we read row 0 of — keeps the injected-exchange surface
     to one primitive)."""
     if process_index == 0:
-        from roc_tpu.graph import lux
-        num_nodes, num_edges = lux.read_header(path)
-        raw_rows = lux.read_rows_slice(path, 0, num_nodes)
+        num_nodes, num_edges = read_header(path)
+        raw_rows = read_rows_slice(path, 0, num_nodes)
         row_ptr = np.zeros(num_nodes + 1, dtype=E_DTYPE)
         row_ptr[1:] = raw_rows.astype(E_DTYPE)
         assert np.all(np.diff(row_ptr) >= 0), "non-monotone .lux offsets"
@@ -150,13 +150,11 @@ def load_local_shards(path: str, meta: PartitionMeta,
         if n > 0:
             e0 = int(meta.edge_starts[p])
             # local row offsets -> per-vertex degrees for vertices lo..hi
-            from roc_tpu.graph.lux import read_rows_slice
             ends = read_rows_slice(path, lo, hi + 1).astype(np.int64)
             deg = np.diff(np.concatenate([[e0], ends]))
             in_degree[i, :n] = deg.astype(np.float32)
             node_mask[i, :n] = True
             if ne > 0:
-                from roc_tpu.graph.lux import read_cols_slice
                 src_global = read_cols_slice(path, meta.num_nodes, e0,
                                              e0 + ne).astype(np.int64)
                 owner = np.searchsorted(uppers, src_global, side="left")

@@ -265,18 +265,18 @@ int64_t roc_chunk_plan_fill(const int32_t* src, const int32_t* dst,
 
 // ---------------------------------------------------------------------------
 // Halo-map builder (roc_tpu/parallel/halo.py fast path).  For each dest part
-// p the sorted-unique remote padded-global sources form, grouped by owner q,
-// exactly the per-(p,q) send lists; the remap of every edge source into the
-// combined table [S own rows ++ P*K recv rows] is a binary search into that
-// list.  Two-call protocol like the chunk planner: sizes first (fixes K),
-// then fill.  At products scale (1.25e8 edges, P=64) the NumPy build costs
-// ~60 s; this sorts E/P-sized slices per part at memory speed.
-// ---------------------------------------------------------------------------
-
+// p the per-(p,q) send lists are the sorted-unique remote padded-global
+// sources grouped by owner q; each edge source is remapped into the combined
+// table [S own rows ++ P*K recv rows].  Two-call protocol like the chunk
+// planner: sizes first (fixes K), then fill.
+//
 // No sorts anywhere: a byte-mark over the padded id space [0, P*S) makes
 // "sorted unique remote sources" a linear block scan (ids are already
 // (owner, local)-ordered by construction), and the per-edge remap a direct
-// lookup.  All passes are streaming or L2-resident.
+// lookup.  All passes are streaming or L2-resident.  At products scale
+// (1.25e8 edges) this runs in ~3 s vs ~60 s for round-1's per-pair NumPy
+// loops (docs/PERF.md).
+// ---------------------------------------------------------------------------
 
 // sizes_out: [P*P] int64, sizes_out[p*P+q] = rows part p needs from part q.
 int roc_halo_sizes(const int64_t* edge_src, int64_t P, int64_t E, int64_t S,

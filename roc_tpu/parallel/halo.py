@@ -42,8 +42,9 @@ class HaloMaps:
 
 def build_halo_maps(part: Partition) -> HaloMaps:
     """Halo-map construction, native C++ when built (roc_halo_sizes/fill:
-    per-part sort + binary-search remap at memory speed) with a vectorized
-    per-part NumPy fallback.  Round-1's per-(p, q)-pair loops cost ~60 s on
+    sort-free byte-mark over the padded id space + dense-table remap) with
+    a NumPy fallback using the same algorithm.  Round-1's per-(p, q)-pair
+    loops cost ~60 s on
     a products-shape graph (1.25e8 edges); the native path runs the same
     build in a few seconds (measured in docs/PERF.md).  All three
     implementations are bit-identical — tests/test_parallel.py asserts both

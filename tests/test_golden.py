@@ -1,0 +1,44 @@
+"""Golden accuracy curves (docs/GOLDEN.md): fixed-seed end-to-end training
+must reproduce the recorded curve within cross-platform float tolerance.
+This is the framework's version of the reference's de-facto oracle
+(SURVEY §4: correctness regression == accuracy divergence)."""
+
+import jax
+import pytest
+
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gcn
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+
+def _run(name, layers, wd, epochs, seed=1):
+    ds = datasets.get(name, seed=seed)
+    cfg = Config(layers=layers, num_epochs=epochs, learning_rate=0.01,
+                 weight_decay=wd, dropout_rate=0.5, seed=seed,
+                 eval_every=10**9)
+    tr = Trainer(cfg, ds, build_gcn(layers, cfg.dropout_rate))
+    curve = {}
+    for epoch in range(epochs + 1):
+        if epoch in (5, 10, 20):
+            curve[epoch] = jax.device_get(tr.evaluate())
+        if epoch < epochs:
+            tr.run_epoch()
+    return curve
+
+
+@pytest.mark.slow
+def test_golden_cora_curve():
+    curve = _run("cora", [1433, 16, 7], 5e-4, 20)
+    # GOLDEN.md: 96.40 / 98.20 / 97.80 @ epochs 5/10/20 (loss 0.67 @ 20)
+    assert curve[5].val_correct / curve[5].val_all >= 0.94
+    assert curve[20].val_correct / curve[20].val_all >= 0.965
+    assert float(curve[20].train_loss) <= 1.5
+
+
+@pytest.mark.slow
+def test_golden_reddit_small_curve():
+    curve = _run("reddit-small", [602, 128, 41], 1e-4, 10)
+    # GOLDEN.md: saturates by epoch 5; epoch-10 pin with headroom
+    assert curve[10].val_correct / curve[10].val_all >= 0.995
+    assert float(curve[10].train_loss) <= 1.0

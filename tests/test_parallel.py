@@ -117,8 +117,12 @@ def test_halo_moves_fewer_rows_than_allgather():
 def test_fast_halo_builders_equal_reference(parts):
     """The native and vectorized-NumPy builders must be bit-identical to
     the original per-pair loop implementation (kept as the oracle)."""
+    from roc_tpu import native
     from roc_tpu.parallel.halo import (_build_halo_maps_numpy,
                                        _build_halo_maps_reference)
+    # without the native lib, build_halo_maps degenerates to the numpy arm
+    # and the C++ path would pass with zero coverage — make that visible
+    assert native.available(), "native lib not built: C++ halo path untested"
     ds = small_ds()
     part = partition_graph(ds.graph, parts)
     ref = _build_halo_maps_reference(part)
