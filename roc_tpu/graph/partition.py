@@ -230,6 +230,33 @@ def compute_meta(row_ptr: np.ndarray, num_parts: int) -> PartitionMeta:
         edge_starts=np.asarray(edge_lo, np.int64))
 
 
+def edge_block_arrays(g: Csr, part: PartitionMeta):
+    """Exactly-edge-balanced blocks for the edge-sharded aggregation mode
+    (roc_tpu/parallel/spmd.py, `-edge-shard`).
+
+    The vertex partitioner cannot split a vertex's in-edges, so a hub
+    vertex overruns the edge cap and every other shard pays the padded-max
+    tax (see SpmdTrainer._log_shard_stats).  Here the dst-sorted edge list
+    is cut into P blocks of exactly ceil(E/P) edges — mid-vertex cuts
+    allowed, padding tax ~0 regardless of skew.  Both endpoints are padded
+    global ids; dst stays nondecreasing (padded ids are monotone in global
+    vertex id), so each block's segment-sum is still a sorted reduction.
+
+    Returns (edge_src [P, Eb], edge_dst [P, Eb]), both padded-global.
+    """
+    P, S = part.num_parts, part.shard_nodes
+    Eb = _round_up(-(-g.num_edges // P), _EDGE_ALIGN)
+    src = part.to_padded(g.col_idx)
+    dst = part.to_padded(g.dst_idx)
+    pad = P * Eb - g.num_edges
+    # pad edges: src = a guaranteed zero-feature pad row (part 0's first pad
+    # row), dst = the global last pad row (keeps dst ascending; its sums are
+    # dropped with the padding)
+    src = np.concatenate([src, np.full(pad, int(part.num_valid[0]), E_DTYPE)])
+    dst = np.concatenate([dst, np.full(pad, P * S - 1, E_DTYPE)])
+    return src.reshape(P, Eb), dst.reshape(P, Eb)
+
+
 def partition_graph(g: Csr, num_parts: int) -> Partition:
     """Partition + pad a CSR into the static shard layout described above."""
     g.validate()

@@ -35,7 +35,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from roc_tpu import ops
-from roc_tpu.graph.partition import Partition, partition_graph
+from roc_tpu.graph.partition import (Partition, edge_block_arrays,
+                                     partition_graph)
 from roc_tpu.models.model import GraphCtx
 from roc_tpu.parallel.halo import HaloMaps, build_halo_maps
 from roc_tpu.ops.softmax import MASK_NONE
@@ -46,7 +47,13 @@ from roc_tpu.train.driver import BaseTrainer
 @dataclasses.dataclass
 class ShardedGraphData:
     """Per-shard edge arrays, leading axis = 'parts' (sharded).  ``backend``
-    is pytree metadata (static)."""
+    and ``mode`` are pytree metadata (static).
+
+    mode="vertex": contiguous vertex shards own their in-edges (the
+    reference's partitioning); edge_dst is shard-local.  mode="edge":
+    exactly-equal edge blocks (mid-vertex cuts allowed — zero padding tax
+    under skew); both endpoints are padded-global and aggregation ends in a
+    psum_scatter (see partition.edge_block_arrays)."""
     edge_src: jnp.ndarray            # [P, E] int32 (table-local for halo,
                                      #              padded-global for v0)
     edge_dst: jnp.ndarray            # [P, E] int32, ascending per shard
@@ -54,12 +61,14 @@ class ShardedGraphData:
     send_idx: Optional[jnp.ndarray]  # [P, P, K] int32, halo mode only
     plans: object = None             # stacked AggregatePlans ([P, ...] axes)
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
+    mode: str = dataclasses.field(default="vertex",
+                                  metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
     ShardedGraphData,
     data_fields=["edge_src", "edge_dst", "in_degree", "send_idx", "plans"],
-    meta_fields=["backend"])
+    meta_fields=["backend", "mode"])
 
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
@@ -103,6 +112,33 @@ def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
     from roc_tpu.train.driver import pallas_interpret
     edge_src, edge_dst = gd_block.edge_src, gd_block.edge_dst
     interp = pallas_interpret()
+
+    if gd_block.mode == "edge":
+        def aggregate_edge(x, aggr):
+            # Every device sums exactly Eb edges into the padded-global id
+            # space (dst ascending there), then one reduce-scatter lands
+            # each vertex shard's rows on its owner.  Work balance is exact
+            # even for hub vertices; comms are O(N) (all_gather + scatter) —
+            # the trade documented in docs/PERF.md.
+            if aggr not in ("sum", "avg"):
+                raise ValueError(
+                    f"edge-sharded aggregation supports sum/avg, not {aggr}"
+                    " (use vertex sharding for max/min models)")
+            table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)  # [P*S, H]
+            partial = ops.scatter_gather(table, edge_src, edge_dst,
+                                         table.shape[0], "sum")
+            out = jax.lax.psum_scatter(partial, PARTS_AXIS,
+                                       scatter_dimension=0, tiled=True)
+            if aggr == "avg":   # all in-edges of a vertex => count = degree
+                out = out / jnp.maximum(gd_block.in_degree, 1.0)[:, None]
+            return out
+
+        def attend_edge(h, a_src, a_dst, slope):
+            raise NotImplementedError(
+                "GAT attention is not supported with -edge-shard")
+
+        return GraphCtx(aggregate=aggregate_edge,
+                        in_degree=gd_block.in_degree, attend=attend_edge)
 
     def aggregate(x, aggr):
         table = _exchange(gd_block, use_halo, x)
@@ -167,6 +203,15 @@ class SpmdTrainer(BaseTrainer):
         """Single-host path: whole graph in memory, all P parts built."""
         cfg, ds = self.config, self.dataset
         self.part = partition_graph(ds.graph, cfg.num_parts)
+        if cfg.edge_shard:
+            self.halo = None
+            eb_src, eb_dst = edge_block_arrays(ds.graph, self.part.meta)
+            assert self.part.num_parts * self.part.shard_nodes < 2**31
+            return ShardedGraphData(
+                edge_src=jnp.asarray(eb_src, jnp.int32),
+                edge_dst=jnp.asarray(eb_dst, jnp.int32),
+                in_degree=jnp.asarray(self.part.in_degree, jnp.float32),
+                send_idx=None, plans=None, backend=backend, mode="edge")
         self.halo = build_halo_maps(self.part) if cfg.halo else None
         return shard_graph(self.part, self.halo, backend)
 

@@ -57,6 +57,8 @@ class Config:
     multihost: bool = False       # jax.distributed.initialize() before run
     perhost_load: bool = False    # each process reads only its parts' .lux
                                   # byte ranges (pod-scale; needs -file)
+    edge_shard: bool = False      # exactly-equal edge blocks + psum_scatter
+                                  # (skew-proof aggregation; sum/avg only)
 
 
 def parse_args(argv: List[str]) -> Config:
@@ -95,6 +97,7 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-profile", dest="profile_dir", default="")
     p.add_argument("-multihost", action="store_true")
     p.add_argument("-perhost", dest="perhost_load", action="store_true")
+    p.add_argument("-edge-shard", dest="edge_shard", action="store_true")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

@@ -140,6 +140,13 @@ class BaseTrainer:
         aggregation; don't pay plan construction when the built model
         contains no sum-aggregate op."""
         cfg = self.config
+        if getattr(cfg, "edge_shard", False):
+            # edge-sharded aggregation is its own data path (psum_scatter of
+            # per-block partial sums); the plan backends don't apply to it
+            if cfg.aggregate_backend not in ("auto", "xla"):
+                print(f"# -edge-shard ignores aggregate_backend="
+                      f"{cfg.aggregate_backend}; using xla")
+            return "xla"
         backend = resolve_backend(cfg.aggregate_backend,
                                   self.dataset.graph.num_edges)
         aggrs = {op.attrs["aggr"] for op in self.model.ops
