@@ -17,7 +17,8 @@ numbers (BASELINE.md), so REF_EPOCH_S holds the MLSys'20 paper's reported
 it.  vs_baseline > 1 means faster than that reference number.
 
 Env knobs:
-  ROC_BENCH_BACKEND  aggregation backend: auto|xla|matmul|pallas (default auto)
+  ROC_BENCH_BACKEND  aggregation backend: auto|xla|matmul|binned (default auto;
+                     "pallas" is accepted as an alias of binned)
   ROC_BENCH_EPOCHS   measured epochs (default 10)
   ROC_BENCH_SCALE    graph-size multiplier for smoke tests (default 1.0;
                      the canonical metric requires 1.0 — smaller scales
@@ -120,9 +121,10 @@ def run():
     from roc_tpu.train.config import Config
     from roc_tpu.train.driver import Trainer, device_sync
 
-    if BACKEND not in ("auto", "xla", "matmul", "pallas"):
+    if BACKEND not in ("auto", "xla", "matmul", "pallas", "binned"):
         raise ValueError(f"ROC_BENCH_BACKEND={BACKEND!r}: "
-                         f"must be auto|xla|matmul|pallas")
+                         f"must be auto|xla|matmul|binned (or the alias "
+                         f"pallas)")
     n_dev = len(_init_devices())
 
     t0 = time.time()

@@ -1,6 +1,6 @@
 from roc_tpu.ops.aggregate import (
-    AggregatePlans, build_aggregate_plans, pad_plans, scatter_gather,
-    scatter_gather_matmul, scatter_gather_pallas)
+    AggregatePlans, BinnedPlans, build_aggregate_plans, build_binned_plans,
+    pad_plans, scatter_gather, scatter_gather_binned, scatter_gather_matmul)
 from roc_tpu.ops.edge import edge_softmax, gat_attend
 from roc_tpu.ops.norm import indegree_norm
 from roc_tpu.ops.linear import linear
@@ -12,7 +12,8 @@ from roc_tpu.ops.softmax import (
 from roc_tpu.ops.init import glorot_uniform
 
 __all__ = [
-    "scatter_gather", "scatter_gather_matmul", "scatter_gather_pallas",
+    "scatter_gather", "scatter_gather_matmul",
+    "scatter_gather_binned", "BinnedPlans", "build_binned_plans",
     "edge_softmax", "gat_attend",
     "indegree_norm", "linear", "relu", "sigmoid", "elu",
     "apply_activation", "add",

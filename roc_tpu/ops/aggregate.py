@@ -3,9 +3,10 @@
 Semantics (scattergather_kernel.cu:20-76): for every destination vertex v,
 ``out[v] = Σ_{e : dst(e)=v} x[src(e)]`` — a sum over in-edges.  The reference
 runs a block-cooperative CUDA kernel with a CUB prefix-scan; on TPU the same
-contraction is a gather + sorted segment-sum, which XLA lowers to efficient
-dynamic-slice/scatter loops, and which Pallas re-implements as a blocked CSR
-kernel for the hot path (roc_tpu/ops/pallas/segment_sum.py).
+contraction has three backends: gather + sorted segment-sum (`xla`, the
+oracle), scatter-free one-hot MXU matmuls over a host-built chunk plan
+(`matmul`, fp32-exact), and the binned two-phase Pallas kernels
+(`binned`, the hardware fast path — roc_tpu/ops/pallas/binned.py).
 
 Backward needs no hand-written task pair (the reference reuses its forward
 kernel on the transposed role, scattergather_kernel.cu:160-170): JAX
@@ -97,7 +98,7 @@ def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
 
 
 # ---------------------------------------------------------------------------
-# Pallas backend (sum only): blocked CSR kernel + transposed-plan backward.
+# Chunk plans shared by the one-hot (matmul) backend.
 # ---------------------------------------------------------------------------
 
 class AggregatePlans(NamedTuple):
@@ -249,7 +250,7 @@ def scatter_gather_matmul(x, plans: AggregatePlans, num_rows: int,
                           table_rows: int, precision: str = "highest"):
     """Sum-aggregation via one-hot MXU matmuls (no scatter, no Pallas).
 
-    Same plan/plumbing as :func:`scatter_gather_pallas`; `precision` feeds
+    Plan-driven like the binned backend; `precision` feeds
     the one-hot dots — "highest" keeps fp32-exact sums (the one-hot factor
     is exact in bf16, so error comes only from rounding the features), while
     "default" trades ~1e-2 relative error for single-pass MXU throughput.
@@ -274,40 +275,50 @@ def _mm_bwd(num_rows, table_rows, precision, plans, g):
 scatter_gather_matmul.defvjp(_mm_fwd, _mm_bwd)
 
 
-def _run_plan(x, obi, first, edst, esrc, num_rows, interpret):
-    from roc_tpu.ops.pallas.segment_sum import VB, _run
-    num_windows = (num_rows + VB - 1) // VB
-    # The kernel's window height (VB=8) is the fp32 sublane tile; run the
-    # kernel in fp32 regardless of activation dtype (bf16 would need a
-    # (16,128) tile and breaks the revisit/accumulate layout).
-    out = _run(x.astype(jnp.float32), obi, first, edst, esrc,
-               num_chunks=obi.shape[0], num_windows=num_windows,
-               interpret=interpret)
-    return out[:num_rows].astype(x.dtype)
+# ---------------------------------------------------------------------------
+# Binned backend (sum only): two-phase Pallas kernels, gather-free.
+# ---------------------------------------------------------------------------
+
+class BinnedPlans(NamedTuple):
+    """Fwd + transposed-bwd binned schedules (see ops/pallas/binned.py).
+
+    Same role as :class:`AggregatePlans` for the plan-based one-hot
+    backends; the payloads are :class:`roc_tpu.ops.pallas.binned.BinnedPlan`
+    dataclasses (registered pytrees with static geometry fields)."""
+    fwd: object
+    bwd: object
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def scatter_gather_pallas(x, plans: AggregatePlans, num_rows: int,
-                          table_rows: int, interpret: bool = False):
-    """Sum-aggregation via the Pallas blocked-CSR kernel.
-
-    x: [table_rows, H] -> out [num_rows, H].  Differentiable w.r.t. x; the
-    VJP runs the same kernel on the transposed plan."""
-    return _run_plan(x, plans.fwd_obi, plans.fwd_first, plans.fwd_edst,
-                     plans.fwd_esrc, num_rows, interpret)
-
-
-def _sg_fwd(x, plans, num_rows, table_rows, interpret):
-    return scatter_gather_pallas(x, plans, num_rows, table_rows,
-                                 interpret), plans
+def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
+                       num_rows: int, table_rows: int) -> BinnedPlans:
+    """Schedules for out = A@x (fwd) and grad_x = A^T@grad (bwd) — the bwd
+    plan swaps roles exactly as the reference re-launches its forward
+    kernel transposed (scattergather_kernel.cu:160-170)."""
+    from roc_tpu.ops.pallas.binned import build_binned_plan
+    return BinnedPlans(
+        fwd=build_binned_plan(edge_src, edge_dst, num_rows, table_rows),
+        bwd=build_binned_plan(edge_dst, edge_src, table_rows, num_rows))
 
 
-def _sg_bwd(num_rows, table_rows, interpret, plans, g):
-    gx = _run_plan(g, plans.bwd_obi, plans.bwd_first, plans.bwd_edst,
-                   plans.bwd_esrc, table_rows, interpret)
-    none_cotangents = jax.tree.map(
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scatter_gather_binned(x, plans: BinnedPlans, interpret: bool = False):
+    """Sum-aggregation via the binned two-phase kernels (fast path: one bf16
+    rounding of features, fp32 accumulation — the fp32-exact path is
+    :func:`scatter_gather_matmul`).  Differentiable w.r.t. x."""
+    from roc_tpu.ops.pallas.binned import run_binned
+    return run_binned(x, plans.fwd, interpret)
+
+
+def _bn_fwd(x, plans, interpret):
+    return scatter_gather_binned(x, plans, interpret), plans
+
+
+def _bn_bwd(interpret, plans, g):
+    from roc_tpu.ops.pallas.binned import run_binned
+    gx = run_binned(g, plans.bwd, interpret)
+    zero = jax.tree.map(
         lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
-    return gx, none_cotangents
+    return gx, zero
 
 
-scatter_gather_pallas.defvjp(_sg_fwd, _sg_bwd)
+scatter_gather_binned.defvjp(_bn_fwd, _bn_bwd)

@@ -1,0 +1,362 @@
+"""Binned two-phase sum-aggregation — the TPU answer to the reference's
+`aggre_coop_kernel` (scattergather_kernel.cu:20-76) at full-graph scale.
+
+Why a second kernel family exists (measured on v5e, docs/PERF.md): XLA
+lowers the [E]-row gather behind every aggregation to a dynamic-slice loop
+that issues one row per ~10 ns and reads a full (8,128) tile per row — at
+Reddit scale (23.5M edges) the gather alone costs 235-300 ms, ~80% of the
+epoch.  The reference never pays this: its CUDA kernel's random accesses
+ride a GPU cache hierarchy.  TPUs have no HBM cache, so the fix is to
+restructure the data movement itself, radix-style:
+
+  PHASE 1 (bin scatter, sequential reads): edges are pre-sorted by
+    (source block, destination bin).  The kernel streams x one SB-row
+    block at a time (large sequential DMAs — no per-row gather), expands
+    each chunk of CH edges into their source rows with ONE one-hot MXU
+    matmul (T[CH, SB] @ xblk[SB, H]), and DMA-writes the result to a
+    staging buffer in SLOT-row groups at plan-computed, slot-aligned
+    offsets.  Staging is laid out bin-major, so phase 1 is a blocked
+    transpose from source order to destination-bin order.
+
+  PHASE 2 (windowed scatter, sequential reads): staging is consumed in
+    chunk-sized sequential DMAs; each chunk belongs to ONE bin of RB
+    destination rows held resident in VMEM, and one one-hot matmul
+    (S[CH2, RB]^T @ chunk) scatter-adds the rows into the bin.  fp32
+    accumulation; rows may sit in any order inside a bin, which is what
+    lets phase 1 write cells block-major without a per-bin sort.
+
+  Bin GROUPS stripe the staging buffer: phases 1+2 run per group of bins
+  (a lax.scan over stacked per-group plans), so staging holds ~E/G rows
+  instead of E; x is re-read once per group, which is noise (the table
+  is ~100x smaller than the edge stream).
+
+Cost per aggregation: read x G times (sequential) + write staging once
+(SLOT-row DMAs with block-cell run locality) + read staging once
+(sequential) + one-hot matmuls (~E*(SB+RB)*H MACs, bf16).  Staging rides
+bf16 — one-hot factors are exact, so features take exactly one bf16
+rounding; accumulation stays fp32.  The fp32-exact path remains the
+`matmul` backend (roc_tpu/ops/aggregate.py).
+
+Static-shape discipline: every (source-block, bin) cell is padded to a
+multiple of SLOT rows, every source block's chunk count and every bin's
+chunk count to whole chunks, and per-group chunk counts to a common max.
+Pad rows carry src-local 0 and dst-local RB; phase 2 zero-masks dst-local
+RB rows *before* the dot so uninitialized staging garbage (even NaN)
+cannot leak through a 0 coefficient.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SB = 512      # source rows per x block (phase-1 streaming unit)
+CH = 2048     # edge slots per phase-1 chunk
+SLOT = 32     # staging write granularity (rows; multiple of bf16 sublane 16)
+RB = 512      # destination rows per bin (phase-2 resident window)
+CH2 = 4096    # staging rows per phase-2 chunk
+NSLOT = CH // SLOT
+SLOT2 = CH2 // SLOT   # slots per phase-2 chunk
+
+# Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).
+_GROUP_ROW_TARGET = 1 << 21
+
+
+@dataclasses.dataclass(frozen=True)
+class BinnedPlan:
+    """One direction (out = A @ x) of a binned aggregation schedule.
+
+    Array fields carry a leading [G] group axis; int fields are static.
+      p1_srcl [G, C1*CH, 1]  src row local to its block (pad rows: 0)
+      p1_off  [G, C1, NSLOT] staging SLOT index per chunk slot
+      p1_blk  [G, C1]        x block index per chunk
+      p2_dstl [G, C2*CH2, 1] dst row local to its bin (pad rows: RB)
+      p2_obi  [G, C2]        group-local bin index per chunk (nondecreasing)
+      p2_first[G, C2]        1 iff first chunk of its bin
+    """
+    p1_srcl: jnp.ndarray
+    p1_off: jnp.ndarray
+    p1_blk: jnp.ndarray
+    p2_dstl: jnp.ndarray
+    p2_obi: jnp.ndarray
+    p2_first: jnp.ndarray
+    num_rows: int = dataclasses.field(metadata={"static": True}, default=0)
+    table_rows: int = dataclasses.field(metadata={"static": True}, default=0)
+    bins_per_group: int = dataclasses.field(
+        metadata={"static": True}, default=0)
+
+
+jax.tree_util.register_dataclass(
+    BinnedPlan,
+    data_fields=["p1_srcl", "p1_off", "p1_blk",
+                 "p2_dstl", "p2_obi", "p2_first"],
+    meta_fields=["num_rows", "table_rows", "bins_per_group"])
+
+
+def _pad_to(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """Exclusive prefix sum of `values` restarted at each change of `keys`
+    (keys must be grouped).  Both [n]; returns [n]."""
+    if len(values) == 0:
+        return np.zeros(0, np.int64)
+    csum = np.cumsum(values) - values
+    first = np.concatenate([[True], keys[1:] != keys[:-1]])
+    run_len = np.diff(np.concatenate([np.flatnonzero(first), [len(keys)]]))
+    return csum - np.repeat(csum[first], run_len)
+
+
+def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
+                      num_rows: int, table_rows: int,
+                      group_row_target: int = _GROUP_ROW_TARGET
+                      ) -> BinnedPlan:
+    """Host-side schedule: sort, slot-pad, and position every edge for both
+    phases.  Pure vectorized NumPy (one lexsort + prefix sums)."""
+    edge_src = np.asarray(edge_src, np.int64)
+    edge_dst = np.asarray(edge_dst, np.int64)
+    E = edge_src.shape[0]
+    num_bins = max(-(-num_rows // RB), 1)
+    num_blocks = max(-(-table_rows // SB), 1)
+
+    bins_per_group = max(min(
+        num_bins,
+        # bins such that expected group rows ~ group_row_target:
+        int(group_row_target / max(E / num_bins, 1))), 1)
+    G = -(-num_bins // bins_per_group)
+
+    bin_of = edge_dst // RB
+    blk_of = edge_src // SB
+    grp_of = bin_of // bins_per_group
+
+    # Sort edges by (group, block, bin); order within a cell is free.
+    order = np.lexsort((bin_of, blk_of, grp_of))
+    s_src, s_dst = edge_src[order], edge_dst[order]
+    s_bin, s_blk, s_grp = bin_of[order], blk_of[order], grp_of[order]
+
+    # --- cells = (g, blk, bin), in sorted-edge order ----------------------
+    cell_key = (s_grp * num_blocks + s_blk) * num_bins + s_bin
+    uniq, cell_start, cell_cnt = np.unique(
+        cell_key, return_index=True, return_counts=True)
+    ncell = len(uniq)
+    cell_slots = -(-cell_cnt // SLOT)
+    cell_g = uniq // (num_bins * num_blocks)
+    cell_lbin = (uniq % num_bins) - cell_g * bins_per_group
+
+    # --- phase-1 layout: per (g, blk) stream, cells in order --------------
+    gb_key = uniq // num_bins                      # g * num_blocks + blk
+    gb_uniq, gb_inv = np.unique(gb_key, return_inverse=True)
+    gb_slots = np.zeros(len(gb_uniq), np.int64)
+    np.add.at(gb_slots, gb_inv, cell_slots)
+    gb_chunks = -(-gb_slots // NSLOT)
+    gb_g = gb_uniq // num_blocks
+    c1_per_g = np.zeros(G, np.int64)
+    np.add.at(c1_per_g, gb_g, gb_chunks)
+    C1 = int(_pad_to(max(int(c1_per_g.max(initial=0)), 1), 8))
+    # chunk base of each (g, blk) stream within its group:
+    gb_chunk_base = _prefix_within_runs(gb_chunks, gb_g)
+    # slot base of each cell within its (g, blk) stream:
+    cell_p1_slot = _prefix_within_runs(cell_slots, gb_key)
+
+    # --- phase-2 layout: per group, bins in order, block-major cells ------
+    dense_bin_slots = np.zeros(G * bins_per_group, np.int64)
+    bin_idx = cell_g * bins_per_group + cell_lbin
+    np.add.at(dense_bin_slots, bin_idx, cell_slots)
+    dense_bin_chunks = np.maximum(-(-dense_bin_slots // SLOT2), 1)
+    c2_per_g = dense_bin_chunks.reshape(G, bins_per_group).sum(1)
+    C2 = int(max(int(c2_per_g.max(initial=0)), 1))
+    # bin chunk base within its group:
+    bin_g = np.repeat(np.arange(G), bins_per_group)
+    bin_chunk_base = _prefix_within_runs(dense_bin_chunks, bin_g)
+    # cell slot base within its bin (cells grouped by bin, keeping the
+    # block-major cell order):
+    bo = np.argsort(bin_idx, kind="stable")
+    cell_off_in_bin = np.zeros(ncell, np.int64)
+    cell_off_in_bin[bo] = _prefix_within_runs(cell_slots[bo], bin_idx[bo])
+    # absolute staging slot of each cell (group-local):
+    cell_stg_slot = bin_chunk_base[bin_idx] * SLOT2 + cell_off_in_bin
+
+    # --- per-edge positions ------------------------------------------------
+    edge_cell = np.repeat(np.arange(ncell), cell_cnt)
+    in_cell = np.arange(E) - np.repeat(cell_start, cell_cnt)
+    p1_row = (gb_chunk_base[gb_inv[edge_cell]] * CH
+              + cell_p1_slot[edge_cell] * SLOT + in_cell)
+    stg_row = cell_stg_slot[edge_cell] * SLOT + in_cell
+
+    # --- per-slot staging offsets ------------------------------------------
+    total_slots = int(cell_slots.sum())
+    slot_cell = np.repeat(np.arange(ncell), cell_slots)
+    slot_in_cell = (np.arange(total_slots)
+                    - np.repeat(np.cumsum(cell_slots) - cell_slots,
+                                cell_slots))
+    p1_slot_pos = (gb_chunk_base[gb_inv[slot_cell]] * NSLOT
+                   + cell_p1_slot[slot_cell] + slot_in_cell)
+    stg_slot = cell_stg_slot[slot_cell] + slot_in_cell
+
+    # --- materialize -------------------------------------------------------
+    scratch_slot = C2 * SLOT2          # base of the trailing scratch chunk
+    p1_srcl = np.zeros((G, C1 * CH), np.int32)
+    p1_blk = np.zeros((G, C1), np.int32)
+    p1_off = np.full((G, C1, NSLOT), scratch_slot, np.int32)
+    g_of_edge = cell_g[edge_cell]
+    p1_srcl[g_of_edge, p1_row] = (s_src - s_blk * SB).astype(np.int32)
+    if len(gb_uniq):
+        blk_rep = np.repeat(gb_uniq % num_blocks, gb_chunks)
+        pos_rep = (np.repeat(gb_chunk_base, gb_chunks)
+                   + _prefix_within_runs(np.ones_like(blk_rep),
+                                         np.repeat(np.arange(len(gb_uniq)),
+                                                   gb_chunks)))
+        p1_blk[np.repeat(gb_g, gb_chunks), pos_rep] = blk_rep.astype(np.int32)
+    g_of_slot = cell_g[slot_cell]
+    p1_off[g_of_slot, p1_slot_pos // NSLOT,
+           p1_slot_pos % NSLOT] = stg_slot.astype(np.int32)
+
+    p2_dstl = np.full((G, C2 * CH2), RB, np.int32)
+    p2_dstl[g_of_edge, stg_row] = (s_dst - s_bin * RB).astype(np.int32)
+    p2_obi = np.zeros((G, C2), np.int32)
+    p2_first = np.zeros((G, C2), np.int32)
+    dbc = dense_bin_chunks.reshape(G, bins_per_group)
+    for g in range(G):
+        reps = dbc[g]
+        obi = np.repeat(np.arange(bins_per_group), reps).astype(np.int32)
+        first = np.zeros(len(obi), np.int32)
+        first[np.cumsum(reps) - reps] = 1
+        p2_obi[g, :len(obi)] = obi
+        p2_first[g, :len(obi)] = first
+        if len(obi) < C2:   # pad chunks: revisit last bin, add only zeros
+            p2_obi[g, len(obi):] = obi[-1]
+    return BinnedPlan(
+        p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * CH, 1)),
+        p1_off=jnp.asarray(p1_off),
+        p1_blk=jnp.asarray(p1_blk),
+        p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * CH2, 1)),
+        p2_obi=jnp.asarray(p2_obi),
+        p2_first=jnp.asarray(p2_first),
+        num_rows=num_rows, table_rows=table_rows,
+        bins_per_group=bins_per_group)
+
+
+# ---------------------------------------------------------------------------
+# Phase-1 kernel: one-hot expand + slot-scatter to staging.
+# ---------------------------------------------------------------------------
+
+def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, sem):
+    c = pl.program_id(0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+    t = (lane == srcl_ref[:]).astype(jnp.bfloat16)
+    gbuf[:] = jax.lax.dot_general(
+        t, x_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+    # off rides in (8, NSLOT) SMEM blocks; this chunk's row is c % 8.
+    def issue(s, _):
+        pltpu.make_async_copy(
+            gbuf.at[pl.ds(s * SLOT, SLOT)],
+            stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)], sem).start()
+        return 0
+    jax.lax.fori_loop(0, NSLOT, issue, 0)
+
+    def drain(s, _):
+        pltpu.make_async_copy(
+            gbuf.at[pl.ds(s * SLOT, SLOT)],
+            stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)], sem).wait()
+        return 0
+    jax.lax.fori_loop(0, NSLOT, drain, 0)
+
+
+@partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret"))
+def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
+            interpret: bool = False):
+    H = x.shape[-1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,                  # blk [C1]
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((8, NSLOT), lambda c, blk: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, blk: (c, 0)),
+            pl.BlockSpec((SB, H), lambda c, blk: (blk[c], 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((CH, H), jnp.bfloat16),
+                        pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        _p1_kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((stg_rows, H), jnp.bfloat16),
+        interpret=interpret,
+    )(blk, off, srcl, x)
+
+
+# ---------------------------------------------------------------------------
+# Phase-2 kernel: sequential staging read + windowed one-hot scatter.
+# ---------------------------------------------------------------------------
+
+def _p2_kernel(obi_ref, first_ref, dstl_ref, stg_ref, out_ref):
+    c = pl.program_id(0)
+
+    @pl.when(first_ref[c] == 1)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # Zero-mask pad/garbage rows BEFORE the dot: a 0 one-hot coefficient
+    # alone would still propagate NaN garbage (0 * NaN = NaN).
+    rows = jnp.where(dstl_ref[:] == RB, jnp.bfloat16(0), stg_ref[:])
+    lane = jax.lax.broadcasted_iota(jnp.int32, (CH2, RB), 1)
+    s_t = (lane == dstl_ref[:]).astype(jnp.bfloat16)   # [CH2, RB]
+    out_ref[:] += jax.lax.dot_general(
+        s_t, rows, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("nchunks", "out_rows", "interpret"))
+def _p2_run(stg, obi, first, dstl, nchunks: int, out_rows: int,
+            interpret: bool = False):
+    H = stg.shape[-1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                  # obi, first
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((CH2, 1), lambda c, obi, first: (c, 0)),
+            pl.BlockSpec((CH2, H), lambda c, obi, first: (c, 0)),
+        ],
+        out_specs=pl.BlockSpec((RB, H), lambda c, obi, first: (obi[c], 0)),
+    )
+    return pl.pallas_call(
+        _p2_kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_rows, H), jnp.float32),
+        interpret=interpret,
+    )(obi, first, dstl, stg)
+
+
+def run_binned(x, plan: BinnedPlan, interpret: bool = False):
+    """out[v] = sum over in-edges of x[src] via the two-phase schedule.
+
+    x: [table_rows, H] (any float dtype) -> [num_rows, H] in x.dtype.
+    fp32 accumulation; features take one bf16 rounding (see module doc)."""
+    H = x.shape[-1]
+    G, C1 = plan.p1_blk.shape
+    C2 = plan.p2_obi.shape[1]
+    xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, SB) - x.shape[0]), (0, 0)))
+    stg_rows = C2 * CH2 + CH2          # + trailing scratch chunk
+
+    def body(_, gplan):
+        srcl, off, blk, dstl, obi, first = gplan
+        stg = _p1_run(xp, blk, off, srcl, C1, stg_rows, interpret)
+        out_g = _p2_run(stg, obi, first, dstl, C2,
+                        plan.bins_per_group * RB, interpret)
+        return None, out_g
+
+    _, outs = jax.lax.scan(
+        body, None,
+        (plan.p1_srcl, plan.p1_off, plan.p1_blk,
+         plan.p2_dstl, plan.p2_obi, plan.p2_first))
+    out = outs.reshape(G * plan.bins_per_group * RB, H)
+    return out[:plan.num_rows].astype(x.dtype)

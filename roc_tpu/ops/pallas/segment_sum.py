@@ -1,49 +1,30 @@
-"""Pallas TPU kernel for CSR sum-aggregation (the reference's
+"""Chunk-plan machinery for one-hot CSR sum-aggregation (the reference's
 `aggre_coop_kernel`, scattergather_kernel.cu:20-76).
 
-The reference's CUDA kernel is block-cooperative: a thread block claims a
-group of consecutive vertices, prefix-sums their degrees with CUB, stages
-source rows through shared memory and atomically accumulates.  The TPU
-formulation below is the same idea mapped onto DMA + MXU instead of
-warps + atomics:
+Round-2 note: the blocked-CSR Pallas kernel that originally lived here was
+removed — its per-edge row DMAs (`x_hbm.at[esrc[e]]`) cannot lower on
+hardware (Mosaic rejects 1-row slices of (8,128)-tiled HBM refs) and its
+per-edge DMA issue rate could never win (docs/PERF.md).  What remains is
+the host-side chunk schedule consumed by the scatter-free `matmul` backend
+(ops/aggregate.py) and the native C++ plan builder; the hardware Pallas
+path is the binned two-phase design in ops/pallas/binned.py.
 
-  * host-side, the sorted in-edge list is cut into CHUNKS of EB edge slots,
-    each chunk owning a WINDOW of VB=8 destination rows (8 = fp32 sublane
-    tile).  A hub vertex simply occupies many consecutive chunks of the
-    same window; sparse windows get one padded chunk (so every output row
-    is visited and zeroed).  This is the static-shape analog of the CUDA
-    kernel's dynamic per-block vertex claiming;
-  * per chunk, the kernel DMA-gathers the EB source rows from the feature
-    table in HBM into VMEM (issue-all-then-wait on one DMA semaphore — the
-    hardware pipelines the row fetches), then scatters them into the
-    window with ONE (VB x EB) @ (EB x H) matmul against a one-hot
-    destination matrix built on the VPU from an iota comparison.  The MXU
-    does the scatter-add; there are no atomics and no per-edge stores;
-  * consecutive chunks sharing a window keep the output block resident in
-    VMEM (Pallas only writes it back when the window index advances, which
-    it does monotonically because the edge list is dst-sorted).
-
-Per edge this costs VB*H MACs on the MXU (VB=8: ~6% systolic utilization —
-the price of scatter-free accumulation) and one H-row DMA.  Whether it
-beats XLA's take+segment_sum depends on the gather path, so the public op
-(roc_tpu.ops.scatter_gather) keeps XLA as the default backend and this
-kernel behind `backend="pallas"`; tests pin both to the same oracle.
-
-Backward uses the same kernel on the transposed edge list (grad_x =
-A^T @ grad_out) — the reference does literally the same role swap
-(scattergather_kernel.cu:160-170).
+The schedule that survives: the dst-sorted in-edge list is cut into
+CHUNKS of EB edge slots, each chunk owning a WINDOW of VB=8 destination
+rows (the fp32 sublane tile).  A hub vertex occupies many consecutive
+chunks of the same window; sparse windows get one padded chunk so every
+output row is visited and zeroed — the static-shape analog of the CUDA
+kernel's dynamic per-block vertex claiming.  The `matmul` backend turns
+each chunk into one (VB x EB) @ (EB x H) one-hot MXU matmul; backward
+reuses the machinery on the transposed edge list (grad_x = A^T @ grad),
+the same role swap the reference performs (scattergather_kernel.cu:160-170).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 VB = 8       # destination window rows (fp32 sublane tile)
 EB = 256     # edge slots per chunk
@@ -136,79 +117,3 @@ def build_chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
         obi=obi.astype(np.int32), first=first,
         esrc=esrc.astype(np.int32), edst=edst.astype(np.int32),
         out_rows=num_windows * VB)
-
-
-def _kernel(obi_ref, first_ref, edst_ref, esrc_ref, x_hbm, out_ref,
-            xbuf, sem):
-    c = pl.program_id(0)
-
-    @pl.when(first_ref[c] == 1)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    # Gather the chunk's EB source rows HBM -> VMEM.  One semaphore counts
-    # all completions; the DMA engine overlaps the row fetches.  esrc rides
-    # in (CPAD, EB) SMEM blocks; this chunk's addresses are row c % CPAD.
-    cm = c % CPAD
-
-    def issue(e, _):
-        pltpu.make_async_copy(
-            x_hbm.at[esrc_ref[cm, e]], xbuf.at[e], sem).start()
-        return 0
-    jax.lax.fori_loop(0, EB, issue, 0)
-
-    def drain(e, _):
-        pltpu.make_async_copy(
-            x_hbm.at[esrc_ref[cm, e]], xbuf.at[e], sem).wait()
-        return 0
-    jax.lax.fori_loop(0, EB, drain, 0)
-
-    # Select this chunk's row of the (CPAD, EB) edst block with a masked
-    # sublane reduce (dynamic sublane slicing is not reliably lowerable;
-    # a compare + where + sum always is).
-    sub = jax.lax.broadcasted_iota(jnp.int32, (CPAD, EB), 0)
-    sel = sub == (c % CPAD)
-    dst = jnp.sum(jnp.where(sel, edst_ref[:], 0), axis=0,
-                  keepdims=True)                                 # [1, EB]
-    # One-hot scatter matrix on the VPU: S[v, e] = 1 iff edge e lands on
-    # local row v (pads carry dst=VB so they never match).
-    rows = jax.lax.broadcasted_iota(jnp.int32, (VB, EB), 0)
-    s = (rows == dst).astype(xbuf.dtype)
-    # MXU scatter-add: (VB x EB) @ (EB x H), accumulated into the window.
-    # HIGHEST precision: the default single-pass bf16 MXU mode would round
-    # the gathered fp32 features (the reference accumulates in fp32).
-    out_ref[:] += jax.lax.dot_general(
-        s, xbuf[:], dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST).astype(out_ref.dtype)
-
-
-@partial(jax.jit, static_argnames=("num_chunks", "num_windows", "interpret"))
-def _run(x, obi, first, edst, esrc, num_chunks: int, num_windows: int,
-         interpret: bool = False):
-    H = x.shape[-1]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,          # obi, first
-        grid=(num_chunks,),
-        in_specs=[
-            # edst rides in VMEM as (CPAD, EB) blocks (sublane-tile legal);
-            # the kernel selects row c % CPAD.
-            pl.BlockSpec((CPAD, EB), lambda c, obi, first: (c // CPAD, 0)),
-            pl.BlockSpec((CPAD, EB), lambda c, obi, first: (c // CPAD, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pl.ANY),   # x table stays in HBM
-        ],
-        out_specs=pl.BlockSpec((VB, H), lambda c, obi, first: (obi[c], 0)),
-        scratch_shapes=[
-            pltpu.VMEM((EB, H), x.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
-    )
-    return pl.pallas_call(
-        _kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_windows * VB, H), x.dtype),
-        interpret=interpret,
-    )(obi, first, edst, esrc, x)
-
-

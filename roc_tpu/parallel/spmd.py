@@ -26,6 +26,7 @@ ICI within a slice and DCN across slices.
 from __future__ import annotations
 
 import dataclasses
+import sys
 from functools import partial
 from typing import Optional
 
@@ -78,7 +79,7 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
     else:
         src = part.edge_src.astype(np.int32)
     plans = None
-    if backend in ("pallas", "matmul"):
+    if backend == "matmul":
         P_, S = part.num_parts, part.shard_nodes
         table_rows = S + P_ * halo.K if halo is not None else P_ * S
         plans = ops.pad_plans([
@@ -143,10 +144,6 @@ def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
     def aggregate(x, aggr):
         table = _exchange(gd_block, use_halo, x)
         if gd_block.plans is not None and aggr == "sum":
-            if gd_block.backend == "pallas":
-                return ops.scatter_gather_pallas(table, gd_block.plans,
-                                                 shard_nodes, table.shape[0],
-                                                 interp)
             return ops.scatter_gather_matmul(table, gd_block.plans,
                                              shard_nodes, table.shape[0])
         return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
@@ -238,7 +235,7 @@ class SpmdTrainer(BaseTrainer):
         P_, S = meta.num_parts, meta.shard_nodes
         src = lhalo.edge_src_local if lhalo is not None else local.edge_src
         plans = None
-        if backend in ("pallas", "matmul"):
+        if backend == "matmul":
             table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
             plan_list = [
                 ops.build_aggregate_plans(src[i], local.edge_dst[i], S,
@@ -299,6 +296,14 @@ class SpmdTrainer(BaseTrainer):
         P_ = cfg.num_parts
         self.mesh = make_mesh(P_)
         backend = self._effective_backend()
+        if backend == "binned":
+            # The binned two-phase kernels are single-chip so far; per-shard
+            # edge streams are P-times smaller so the gather tax they attack
+            # is smaller too.  Fall back to the fp32-exact one-hot backend
+            # (sharded binned plans are future work, stacked like pad_plans).
+            print("# aggregate_backend=binned is single-chip; shards use "
+                  "matmul", file=sys.stderr)
+            backend = "matmul"
         gd = self._build_graph_perhost(backend) if cfg.perhost_load \
             else self._build_graph_full(backend)
         if cfg.verbose:

@@ -42,7 +42,7 @@ class Config:
     model: str = "gcn"            # gcn | sage | gin | gat
     heads: int = 8                # attention heads (gat only)
     aggr: str = ""                # "" = model default; sum|avg|max|min
-    aggregate_backend: str = "auto"  # auto | xla | matmul | pallas
+    aggregate_backend: str = "auto"  # auto | xla | matmul | pallas(=binned) | binned
     verbose: bool = False
     eval_every: int = 5           # reference evaluates every 5 epochs (gnn.cc:107)
     checkpoint_path: Optional[str] = None
@@ -83,7 +83,7 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-aggr", default="",
                    choices=["", "sum", "avg", "max", "min"])
     p.add_argument("-aggr-backend", dest="aggregate_backend", default="auto",
-                   choices=["auto", "xla", "matmul", "pallas"])
+                   choices=["auto", "xla", "matmul", "pallas", "binned"])
     p.add_argument("-v", dest="verbose", action="store_true")
     p.add_argument("-eval-every", dest="eval_every", type=int, default=5)
     p.add_argument("-ckpt", dest="checkpoint_path", default=None)

@@ -74,14 +74,22 @@ def resolve_backend(backend: str, num_edges: int) -> str:
         on_tpu = jax.default_backend() == "tpu"
         return "matmul" if (on_tpu and num_edges >= AUTO_MATMUL_EDGES) \
             else "xla"
+    if backend == "pallas":
+        # Round-1's blocked-CSR kernel cannot lower on hardware (per-row DMA
+        # slices of tiled HBM refs; docs/PERF.md); "pallas" now names the
+        # binned two-phase kernel pair (ops/pallas/binned.py).
+        return "binned"
     return backend
 
 
 def dense_graph_data(graph, backend: str = "xla") -> DenseGraphData:
     backend = resolve_backend(backend, graph.num_edges)
     plans = None
-    if backend in ("pallas", "matmul"):
+    if backend == "matmul":
         plans = ops.build_aggregate_plans(
+            graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
+    elif backend == "binned":
+        plans = ops.build_binned_plans(
             graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
     return DenseGraphData(
         edge_src=jnp.asarray(graph.col_idx, jnp.int32),
@@ -97,9 +105,8 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
 
     def aggregate(x, aggr):
         if g.plans is not None and aggr == "sum":
-            if g.backend == "pallas":
-                return ops.scatter_gather_pallas(x, g.plans, num_nodes,
-                                                 x.shape[0], interp)
+            if g.backend == "binned":
+                return ops.scatter_gather_binned(x, g.plans, interp)
             return ops.scatter_gather_matmul(x, g.plans, num_nodes,
                                              x.shape[0])
         return ops.scatter_gather(x, g.edge_src, g.edge_dst, num_nodes, aggr)
@@ -151,7 +158,7 @@ class BaseTrainer:
                                   self.dataset.graph.num_edges)
         aggrs = {op.attrs["aggr"] for op in self.model.ops
                  if op.kind == "aggregate"}
-        if backend in ("pallas", "matmul") and "sum" not in aggrs:
+        if backend in ("binned", "matmul") and "sum" not in aggrs:
             if cfg.aggregate_backend != "auto":   # user explicitly chose it
                 print(f"# aggregate_backend={backend} only accelerates sum "
                       f"aggregation; this model uses {sorted(aggrs)} — "

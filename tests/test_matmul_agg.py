@@ -142,7 +142,8 @@ def test_empty_graph():
 
 
 def test_auto_backend_resolution(monkeypatch):
-    assert resolve_backend("pallas", 100) == "pallas"
+    # "pallas" now aliases the binned two-phase kernel (docs/PERF.md)
+    assert resolve_backend("pallas", 100) == "binned"
     # on non-TPU platforms auto always picks xla (native scatter is fine)
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert resolve_backend("auto", 1 << 21) == "xla"

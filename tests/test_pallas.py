@@ -1,8 +1,11 @@
-"""Pallas blocked-CSR aggregation kernel tests (interpret mode on CPU).
+"""Chunk-plan machinery + plan-backend tests (interpret/CPU).
 
-The XLA take+segment_sum path is the correctness oracle for the kernel
-(SURVEY.md §7.3): forward, VJP via the transposed plan, end-to-end training
-equality, and the sharded (padded-plan) variant are all pinned to it.
+Round-1's blocked-CSR Pallas kernel was removed in round 2: it cannot lower
+on hardware (per-row DMA slices of tiled HBM refs; docs/PERF.md).  Its
+chunk-plan machinery lives on under the `matmul` backend, and the "pallas"
+backend name now resolves to the binned two-phase kernels
+(ops/pallas/binned.py, tests/test_binned.py).  The XLA take+segment_sum
+path remains the correctness oracle (SURVEY.md §7.3).
 """
 
 import jax
@@ -55,8 +58,8 @@ def test_forward_matches_dense():
     _, g, x = graph_and_x()
     plans = ops.build_aggregate_plans(g.col_idx, g.dst_idx, g.num_nodes,
                                       g.num_nodes)
-    out = ops.scatter_gather_pallas(jnp.asarray(x), plans, g.num_nodes,
-                                    g.num_nodes, True)
+    out = ops.scatter_gather_matmul(jnp.asarray(x), plans, g.num_nodes,
+                                    g.num_nodes)
     np.testing.assert_allclose(np.asarray(out), dense_agg(g, x), rtol=1e-5,
                                atol=1e-5)
 
@@ -68,8 +71,8 @@ def test_vjp_matches_transposed_aggregation():
     ct = np.random.default_rng(9).normal(size=x.shape).astype(np.float32)
 
     def f(x):
-        return jnp.sum(ops.scatter_gather_pallas(
-            x, plans, g.num_nodes, g.num_nodes, True) * ct)
+        return jnp.sum(ops.scatter_gather_matmul(
+            x, plans, g.num_nodes, g.num_nodes) * ct)
     grad = jax.grad(f)(jnp.asarray(x))
     a = np.zeros((g.num_nodes, g.num_nodes), np.float32)
     np.add.at(a, (g.dst_idx, g.col_idx), 1.0)
@@ -89,8 +92,8 @@ def test_rectangular_table():
     src[::7] = g.num_nodes + (src[::7] % extra)
     plans = ops.build_aggregate_plans(src, g.dst_idx, g.num_nodes,
                                       table.shape[0])
-    out = ops.scatter_gather_pallas(jnp.asarray(table), plans, g.num_nodes,
-                                    table.shape[0], True)
+    out = ops.scatter_gather_matmul(jnp.asarray(table), plans, g.num_nodes,
+                                    table.shape[0])
     expect = np.zeros_like(x)
     np.add.at(expect, g.dst_idx, table[src])
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
@@ -105,12 +108,15 @@ def test_training_pallas_equals_xla_single_device():
                    aggregate_backend="pallas")
     tx = Trainer(cfg_x, ds, build_gcn(cfg_x.layers, 0.0))
     tp = Trainer(cfg_p, ds, build_gcn(cfg_p.layers, 0.0))
+    # "pallas" resolves to the binned kernels: features take one designed
+    # bf16 rounding per aggregation (ops/pallas/binned.py), so equality to
+    # the fp32-exact xla path is to bf16 tolerance, not bit-level.
     for i in range(3):
         lx, lp = float(tx.run_epoch()), float(tp.run_epoch())
-        np.testing.assert_allclose(lp, lx, rtol=1e-4, err_msg=f"epoch {i}")
+        np.testing.assert_allclose(lp, lx, rtol=5e-3, err_msg=f"epoch {i}")
     np.testing.assert_allclose(
         np.asarray(tp.params["linear_0"]), np.asarray(tx.params["linear_0"]),
-        rtol=1e-4, atol=1e-6)
+        rtol=5e-3, atol=1e-4)
 
 
 @pytest.mark.parametrize("halo", [False, True])
@@ -134,5 +140,5 @@ def test_empty_graph_plan():
     x = jnp.ones((10, 8))
     plans = ops.build_aggregate_plans(np.zeros(0, np.int64),
                                       np.zeros(0, np.int64), 10, 10)
-    out = ops.scatter_gather_pallas(x, plans, 10, 10, True)
+    out = ops.scatter_gather_matmul(x, plans, 10, 10)
     np.testing.assert_array_equal(np.asarray(out), np.zeros((10, 8)))
