@@ -34,8 +34,9 @@ def main(argv=None) -> int:
         print("error: -perhost requires -file and -parts > 1",
               file=sys.stderr)
         return 2
-    if cfg.edge_shard and (cfg.perhost_load or cfg.model == "gat"
-                           or cfg.aggr in ("max", "min")):
+    if cfg.edge_shard in (True, "on") and (
+            cfg.perhost_load or cfg.model == "gat"
+            or cfg.aggr in ("max", "min")):
         print("error: -edge-shard supports sum/avg aggregation and is "
               "incompatible with -perhost and -model gat", file=sys.stderr)
         return 2

@@ -159,6 +159,13 @@ def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
                     attend=attend)
 
 
+def _padded_max_tax(meta) -> float:
+    """E_padded/E_live - 1: what every shard overpays because all shards run
+    the padded-max edge count (the skew cost of vertex partitioning)."""
+    live = np.asarray(meta.num_edges_valid, np.float64)
+    return meta.shard_edges * meta.num_parts / max(live.sum(), 1.0) - 1.0
+
+
 def _squeeze_gd(gd: ShardedGraphData) -> ShardedGraphData:
     """Drop the size-1 parts-axis block dim that shard_map leaves on each
     per-device block."""
@@ -199,8 +206,9 @@ class SpmdTrainer(BaseTrainer):
     def _build_graph_full(self, backend: str) -> ShardedGraphData:
         """Single-host path: whole graph in memory, all P parts built."""
         cfg, ds = self.config, self.dataset
-        self.part = partition_graph(ds.graph, cfg.num_parts)
-        if cfg.edge_shard:
+        if getattr(self, "part", None) is None:
+            self.part = partition_graph(ds.graph, cfg.num_parts)
+        if self._use_edge_shard:
             self.halo = None
             eb_src, eb_dst = edge_block_arrays(ds.graph, self.part.meta)
             assert self.part.num_parts * self.part.shard_nodes < 2**31
@@ -280,29 +288,66 @@ class SpmdTrainer(BaseTrainer):
         reference balances edges precisely because kernel work ∝ edges
         (gnn.cc:806-829); here skew additionally becomes *padding*, the
         scaling ceiling for skewed graphs."""
-        import sys
         if jax.process_index() != 0:   # one banner per pod, not per host
             return
         m = self.part
         live = np.asarray(m.num_edges_valid, np.float64)
-        pad_tax = m.shard_edges * m.num_parts / max(live.sum(), 1.0) - 1.0
+        pad_tax = _padded_max_tax(m)
         print(f"# shards: P={m.num_parts} S={m.shard_nodes} "
               f"E={m.shard_edges} edges/shard min={int(live.min())} "
               f"mean={int(live.mean())} max={int(live.max())} "
               f"padded-max tax={pad_tax * 100:.1f}%", file=sys.stderr)
 
+    # Auto edge-shard threshold: below this padded-max tax, vertex+halo
+    # wins on comms; above it, the padding dominates (measured crossover in
+    # docs/PERF.md — 28% tax was already a wash, 362% a 3.6x win).
+    EDGE_SHARD_TAX = 0.30
+
+    def _resolve_edge_shard(self) -> bool:
+        es = self.config.edge_shard
+        if es in (True, "on"):
+            return True
+        if es in (False, None, "off"):
+            return False
+        # "auto": only sum/avg aggregation is supported, and only skewed
+        # partitions benefit (the padded-max tax IS the skew cost).
+        aggrs = {op.attrs["aggr"] for op in self.model.ops
+                 if op.kind == "aggregate"}
+        if any(op.kind == "gat" for op in self.model.ops):
+            return False
+        if not aggrs or aggrs - {"sum", "avg"}:
+            return False
+        tax = _padded_max_tax(self.part)
+        if tax > self.EDGE_SHARD_TAX:
+            if jax.process_index() == 0:
+                print(f"# padded-max tax {tax * 100:.0f}% > "
+                      f"{self.EDGE_SHARD_TAX:.0%}: auto-enabling edge-"
+                      f"sharded aggregation (-edge-shard off to override)",
+                      file=sys.stderr)
+            return True
+        return False
+
     def _setup(self):
         cfg, ds, model = self.config, self.dataset, self.model
         P_ = cfg.num_parts
         self.mesh = make_mesh(P_)
+        self.part = None
+        if cfg.perhost_load:
+            if cfg.edge_shard in (True, "on") and jax.process_index() == 0:
+                print("# -edge-shard is incompatible with -perhost; using "
+                      "vertex sharding", file=sys.stderr)
+        else:
+            self.part = partition_graph(ds.graph, P_)
+            self._use_edge_shard = self._resolve_edge_shard()
         backend = self._effective_backend()
         if backend == "binned":
             # The binned two-phase kernels are single-chip so far; per-shard
             # edge streams are P-times smaller so the gather tax they attack
             # is smaller too.  Fall back to the fp32-exact one-hot backend
             # (sharded binned plans are future work, stacked like pad_plans).
-            print("# aggregate_backend=binned is single-chip; shards use "
-                  "matmul", file=sys.stderr)
+            if jax.process_index() == 0:
+                print("# aggregate_backend=binned is single-chip; shards "
+                      "use matmul", file=sys.stderr)
             backend = "matmul"
         gd = self._build_graph_perhost(backend) if cfg.perhost_load \
             else self._build_graph_full(backend)

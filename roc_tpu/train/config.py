@@ -57,8 +57,12 @@ class Config:
     multihost: bool = False       # jax.distributed.initialize() before run
     perhost_load: bool = False    # each process reads only its parts' .lux
                                   # byte ranges (pod-scale; needs -file)
-    edge_shard: bool = False      # exactly-equal edge blocks + psum_scatter
-                                  # (skew-proof aggregation; sum/avg only)
+    edge_shard: object = "auto"   # exactly-equal edge blocks + psum_scatter
+                                  # (skew-proof aggregation; sum/avg only).
+                                  # "auto": on when the partitioner's
+                                  # padded-max tax exceeds ~30% (docs/PERF.md
+                                  # rule of thumb); True/"on", False/"off"
+                                  # force it
 
 
 def parse_args(argv: List[str]) -> Config:
@@ -97,7 +101,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-profile", dest="profile_dir", default="")
     p.add_argument("-multihost", action="store_true")
     p.add_argument("-perhost", dest="perhost_load", action="store_true")
-    p.add_argument("-edge-shard", dest="edge_shard", action="store_true")
+    p.add_argument("-edge-shard", dest="edge_shard", nargs="?", const="on",
+                   default="auto", choices=["on", "off", "auto"])
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

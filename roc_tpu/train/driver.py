@@ -132,6 +132,9 @@ class BaseTrainer:
         self.key = jax.random.PRNGKey(config.seed)
         self.epoch = 0
         self.dtype = jnp.bfloat16 if config.use_bf16 else jnp.float32
+        # Edge-sharded aggregation is a multi-device strategy; SpmdTrainer
+        # resolves "auto" from measured partition skew during _setup.
+        self._use_edge_shard = False
         self._setup()
         if config.resume and config.checkpoint_path and \
                 os.path.exists(config.checkpoint_path):
@@ -143,11 +146,11 @@ class BaseTrainer:
         raise NotImplementedError
 
     def _effective_backend(self) -> str:
-        """The plan-based backends (pallas/matmul) only implement sum
+        """The plan-based backends (binned/matmul) only implement sum
         aggregation; don't pay plan construction when the built model
         contains no sum-aggregate op."""
         cfg = self.config
-        if getattr(cfg, "edge_shard", False):
+        if self._use_edge_shard:
             # edge-sharded aggregation is its own data path (psum_scatter of
             # per-block partial sums); the plan backends don't apply to it
             if cfg.aggregate_backend not in ("auto", "xla"):

@@ -12,11 +12,16 @@ from roc_tpu import ops
 from roc_tpu.ops.pallas.binned import RB, SB, SLOT, build_binned_plan, run_binned
 
 
-def _oracle_bf16(x, src, dst, n):
+def oracle_bf16(x, src, dst, n):
+    """The binned backend's numerical contract: features rounded to bf16
+    once, fp32 accumulation.  Shared with tests/test_tpu_hw.py."""
     xb = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32))
     out = np.zeros((n, x.shape[1]), np.float32)
     np.add.at(out, dst, xb[src])
     return out
+
+
+_oracle_bf16 = oracle_bf16
 
 
 CASES = [

@@ -97,3 +97,37 @@ def test_hub_graph_tax_vertex_vs_edge():
     # the hub makes vertex sharding pay heavily; edge blocks stay tight
     assert vertex_tax > 0.30
     assert edge_tax < 0.05
+
+
+def test_edge_shard_auto_selection():
+    """-edge-shard defaults to "auto": hub-skewed partitions flip to edge
+    sharding (padded-max tax > threshold, docs/PERF.md rule of thumb);
+    uniform graphs stay on vertex sharding; GAT never auto-enables."""
+    from roc_tpu.models import build_gat
+
+    g = hub_graph(hub_deg=2000)   # hub in-degree >> per-part edge cap
+    lab = np.zeros(g.num_nodes, np.int64)
+    hub_ds = datasets.Dataset(
+        name="hub", graph=g,
+        features=np.random.default_rng(0).normal(
+            size=(g.num_nodes, 10)).astype(np.float32),
+        labels=None, label_ids=lab,
+        mask=np.zeros(g.num_nodes, np.int32), in_dim=10, num_classes=4)
+    base = dict(layers=[10, 8, 4], num_epochs=1, dropout_rate=0.0,
+                eval_every=10 ** 9, num_parts=4)
+    t = SpmdTrainer(Config(**base), hub_ds, build_gcn(base["layers"], 0.0))
+    assert t._use_edge_shard and t.gdata.mode == "edge"
+
+    uni = small_ds()
+    t2 = SpmdTrainer(Config(**base), uni, build_gcn(base["layers"], 0.0))
+    assert not t2._use_edge_shard and t2.gdata.mode != "edge"
+
+    # explicit off overrides even on the hub graph
+    t3 = SpmdTrainer(Config(**base, edge_shard="off"), hub_ds,
+                     build_gcn(base["layers"], 0.0))
+    assert not t3._use_edge_shard
+
+    # GAT models must never auto-enable (attention needs the source table)
+    t4 = SpmdTrainer(Config(**base, model="gat"), hub_ds,
+                     build_gat(base["layers"], 0.0))
+    assert not t4._use_edge_shard

@@ -19,6 +19,11 @@ import jax.numpy as jnp
 tpu = jax.default_backend() == "tpu"
 pytestmark = pytest.mark.skipif(not tpu, reason="requires a real TPU backend")
 
+try:                                    # pytest (repo root on sys.path)
+    from tests.test_binned import oracle_bf16 as _oracle_bf16
+except ImportError:                     # direct `python tests/test_tpu_hw.py`
+    from test_binned import oracle_bf16 as _oracle_bf16
+
 
 def _cases():
     rng = np.random.default_rng(0)
@@ -29,13 +34,6 @@ def _cases():
         dst[: e // 5] = 11                      # hub destination
         x = rng.standard_normal((t, h), dtype=np.float32)
         yield n, t, src, dst, x
-
-
-def _oracle_bf16(x, src, dst, n):
-    xb = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32))
-    out = np.zeros((n, x.shape[1]), np.float32)
-    np.add.at(out, dst, xb[src])
-    return out
 
 
 def test_binned_compiles_and_matches_on_hw():
