@@ -345,6 +345,211 @@ int roc_halo_fill(const int64_t* edge_src, int64_t P, int64_t E, int64_t S,
 // In-degree computation from inclusive end offsets (device CSR build prep;
 // the reference does this on-GPU in init_graph_kernel, load_task.cu:271-294
 // — on TPU the degree vector is a host-side precompute).
+
+// ---------------------------------------------------------------------------
+// Binned two-phase aggregation plan (roc_tpu/ops/pallas/binned.py fast path).
+// Same two-call protocol as the chunk planner: sizes first (G/C1/C2/bpg),
+// then fill.  No comparison sorts: one counting pass buckets edges by bin
+// group, a second counting pass orders each group's edges by (source block,
+// local bin) — O(E) end to end, which matters because the NumPy lexsort
+// build costs ~17 s per direction at Reddit scale.
+// Geometry constants mirror binned.py; roc_binned_geometry exports them so
+// Python can assert agreement before trusting a native plan.
+// ---------------------------------------------------------------------------
+
+static const int64_t BN_SB = 512, BN_CH = 2048, BN_SLOT = 32;
+static const int64_t BN_RB = 512, BN_CH2 = 4096;
+static const int64_t BN_NSLOT = BN_CH / BN_SLOT;     // 64
+static const int64_t BN_SLOT2 = BN_CH2 / BN_SLOT;    // 128
+static const int64_t BN_K2_CAP = (int64_t)1 << 25;   // binned.py _K2_CAP
+
+void roc_binned_geometry(int64_t* out5) {
+  out5[0] = BN_SB; out5[1] = BN_CH; out5[2] = BN_SLOT;
+  out5[3] = BN_RB; out5[4] = BN_CH2;
+}
+
+static void bn_params(int64_t E, int64_t num_rows, int64_t table_rows,
+                      int64_t group_row_target, int64_t* num_bins,
+                      int64_t* num_blocks, int64_t* bpg, int64_t* G) {
+  *num_bins = (num_rows + BN_RB - 1) / BN_RB;
+  if (*num_bins < 1) *num_bins = 1;
+  *num_blocks = (table_rows + BN_SB - 1) / BN_SB;
+  if (*num_blocks < 1) *num_blocks = 1;
+  double per_bin = (double)E / (double)*num_bins;
+  if (per_bin < 1.0) per_bin = 1.0;
+  int64_t b = (int64_t)((double)group_row_target / per_bin);
+  if (b > *num_bins) b = *num_bins;
+  if (b > BN_K2_CAP / *num_blocks) b = BN_K2_CAP / *num_blocks;
+  if (b < 1) b = 1;
+  *bpg = b;
+  *G = (*num_bins + b - 1) / b;
+}
+
+// Shared walk: buckets edges, computes per-group geometry, and (when fill
+// buffers are non-null) writes every plan array.  Returns 0, or -1 when the
+// caller-passed C1/C2 disagree with the recomputed geometry.
+static int bn_build(const int64_t* src, const int64_t* dst, int64_t E,
+                    int64_t num_rows, int64_t table_rows,
+                    int64_t group_row_target,
+                    int64_t* out_G, int64_t* out_C1, int64_t* out_C2,
+                    int64_t* out_bpg,
+                    int64_t C1, int64_t C2,
+                    int32_t* p1_srcl, int32_t* p1_off, int32_t* p1_blk,
+                    int32_t* p2_dstl, int32_t* p2_obi, int32_t* p2_first) {
+  int64_t num_bins, num_blocks, bpg, G;
+  bn_params(E, num_rows, table_rows, group_row_target,
+            &num_bins, &num_blocks, &bpg, &G);
+  const bool fill = p1_srcl != nullptr;
+  const int64_t rows_pg = BN_RB * bpg;
+
+  // Pass 0: bucket edge ids by group (stable).
+  std::vector<int64_t> gcnt(G + 1, 0);
+  for (int64_t e = 0; e < E; e++) gcnt[dst[e] / rows_pg + 1]++;
+  for (int64_t g = 0; g < G; g++) gcnt[g + 1] += gcnt[g];
+  std::vector<int64_t> eid(E), gpos(gcnt.begin(), gcnt.end() - 1);
+  for (int64_t e = 0; e < E; e++) eid[gpos[dst[e] / rows_pg]++] = e;
+
+  const int64_t K2 = num_blocks * bpg;
+  std::vector<int64_t> ccnt(K2, 0), cbase(K2), pos(K2);
+  std::vector<int64_t> blk_slots(num_blocks), blk_cbase(num_blocks);
+  std::vector<int64_t> bin_slots(bpg), bin_cbase(bpg), bin_off(bpg);
+  std::vector<int64_t> eid2;
+  if (fill) eid2.resize(E);
+  int64_t maxC1 = 1, maxC2 = 1;
+
+  for (int64_t g = 0; g < G; g++) {
+    const int64_t lo = gcnt[g], hi = gcnt[g + 1];
+    // Reset only the cells the previous group touched (ccnt starts zeroed;
+    // a dense std::fill over K2 per group would dominate on sparse graphs).
+    if (g > 0) {
+      const int64_t plo = gcnt[g - 1], phi = gcnt[g];
+      for (int64_t i = plo; i < phi; i++) {
+        const int64_t e = eid[i];
+        ccnt[(src[e] / BN_SB) * bpg
+             + (dst[e] / BN_RB - (g - 1) * bpg)] = 0;
+      }
+    }
+    for (int64_t i = lo; i < hi; i++) {
+      const int64_t e = eid[i];
+      ccnt[(src[e] / BN_SB) * bpg + (dst[e] / BN_RB - g * bpg)]++;
+    }
+    // Geometry: per-block and per-bin slot totals -> chunk bases.
+    std::fill(blk_slots.begin(), blk_slots.end(), 0);
+    std::fill(bin_slots.begin(), bin_slots.end(), 0);
+    for (int64_t k = 0; k < K2; k++) {
+      if (!ccnt[k]) continue;
+      const int64_t slots = (ccnt[k] + BN_SLOT - 1) / BN_SLOT;
+      blk_slots[k / bpg] += slots;
+      bin_slots[k % bpg] += slots;
+    }
+    int64_t c1 = 0, c2 = 0;
+    for (int64_t b = 0; b < num_blocks; b++) {
+      blk_cbase[b] = c1;
+      c1 += (blk_slots[b] + BN_NSLOT - 1) / BN_NSLOT;
+    }
+    for (int64_t b = 0; b < bpg; b++) {
+      bin_cbase[b] = c2;
+      int64_t ch = (bin_slots[b] + BN_SLOT2 - 1) / BN_SLOT2;
+      c2 += ch < 1 ? 1 : ch;
+    }
+    if (c1 > maxC1) maxC1 = c1;
+    if (c2 > maxC2) maxC2 = c2;
+    if (!fill) continue;
+    if (c1 > C1 || c2 > C2) return -1;
+
+    // Cell-order the group's edges (stable counting sort by k2).
+    cbase[0] = 0;
+    for (int64_t k = 1; k < K2; k++) cbase[k] = cbase[k - 1] + ccnt[k - 1];
+    std::copy(cbase.begin(), cbase.end(), pos.begin());
+    for (int64_t i = lo; i < hi; i++) {
+      const int64_t e = eid[i];
+      eid2[lo + pos[(src[e] / BN_SB) * bpg
+                    + (dst[e] / BN_RB - g * bpg)]++] = e;
+    }
+    // Fill: walk cells in (blk, lbin) order.
+    int32_t* srcl = p1_srcl + g * C1 * BN_CH;
+    int32_t* offp = p1_off + g * C1 * BN_NSLOT;
+    int32_t* blkp = p1_blk + g * C1;
+    int32_t* dstl = p2_dstl + g * C2 * BN_CH2;
+    std::fill(bin_off.begin(), bin_off.end(), 0);
+    int64_t blk_slot_run = 0, cur_blk = -1;
+    for (int64_t k = 0; k < K2; k++) {
+      const int64_t cnt = ccnt[k];
+      if (!cnt) continue;
+      const int64_t blk = k / bpg, lbin = k % bpg;
+      if (blk != cur_blk) { cur_blk = blk; blk_slot_run = 0; }
+      const int64_t slots = (cnt + BN_SLOT - 1) / BN_SLOT;
+      const int64_t stg_slot = bin_cbase[lbin] * BN_SLOT2 + bin_off[lbin];
+      const int64_t p1_slot = blk_cbase[blk] * BN_NSLOT + blk_slot_run;
+      for (int64_t kk = 0; kk < slots; kk++)
+        offp[p1_slot + kk] = (int32_t)(stg_slot + kk);
+      const int64_t p1_row = p1_slot * BN_SLOT;
+      const int64_t stg_row = stg_slot * BN_SLOT;
+      const int64_t cello = lo + cbase[k];
+      for (int64_t r = 0; r < cnt; r++) {
+        const int64_t e = eid2[cello + r];
+        srcl[p1_row + r] = (int32_t)(src[e] - blk * BN_SB);
+        dstl[stg_row + r] = (int32_t)(dst[e] - (g * bpg + lbin) * BN_RB);
+      }
+      bin_off[lbin] += slots;
+      blk_slot_run += slots;
+    }
+    for (int64_t b = 0; b < num_blocks; b++) {
+      const int64_t n = (blk_slots[b] + BN_NSLOT - 1) / BN_NSLOT;
+      for (int64_t j = 0; j < n; j++) blkp[blk_cbase[b] + j] = (int32_t)b;
+    }
+    int32_t* obi = p2_obi + g * C2;
+    int32_t* first = p2_first + g * C2;
+    int64_t c = 0;
+    for (int64_t b = 0; b < bpg; b++) {
+      int64_t ch = (bin_slots[b] + BN_SLOT2 - 1) / BN_SLOT2;
+      if (ch < 1) ch = 1;
+      for (int64_t j = 0; j < ch; j++, c++) {
+        obi[c] = (int32_t)b;
+        first[c] = j == 0;
+      }
+    }
+    for (; c < C2; c++) { obi[c] = (int32_t)(bpg - 1); first[c] = 0; }
+  }
+  *out_G = G;
+  *out_C1 = (maxC1 + 7) / 8 * 8;
+  *out_C2 = maxC2;
+  *out_bpg = bpg;
+  return 0;
+}
+
+int roc_binned_plan_sizes(const int64_t* src, const int64_t* dst, int64_t E,
+                          int64_t num_rows, int64_t table_rows,
+                          int64_t group_row_target, int64_t* out4) {
+  return bn_build(src, dst, E, num_rows, table_rows, group_row_target,
+                  &out4[0], &out4[1], &out4[2], &out4[3],
+                  0, 0, nullptr, nullptr, nullptr, nullptr, nullptr,
+                  nullptr);
+}
+
+// Caller allocates: p1_srcl [G*C1*CH], p1_off [G*C1*NSLOT] (pre-filled by
+// this call: unused slots get -1), p1_blk [G*C1], p2_dstl [G*C2*CH2],
+// p2_obi [G*C2], p2_first [G*C2].  Returns 0, -1 on geometry mismatch.
+int roc_binned_plan_fill(const int64_t* src, const int64_t* dst, int64_t E,
+                         int64_t num_rows, int64_t table_rows,
+                         int64_t group_row_target, int64_t G, int64_t C1,
+                         int64_t C2, int32_t* p1_srcl, int32_t* p1_off,
+                         int32_t* p1_blk, int32_t* p2_dstl, int32_t* p2_obi,
+                         int32_t* p2_first) {
+  std::fill(p1_srcl, p1_srcl + G * C1 * BN_CH, 0);
+  std::fill(p1_off, p1_off + G * C1 * BN_NSLOT, -1);
+  std::fill(p1_blk, p1_blk + G * C1, 0);
+  std::fill(p2_dstl, p2_dstl + G * C2 * BN_CH2, (int32_t)BN_RB);
+  std::fill(p2_obi, p2_obi + G * C2, 0);
+  std::fill(p2_first, p2_first + G * C2, 0);
+  int64_t g2, c1, c2, bpg;
+  int rc = bn_build(src, dst, E, num_rows, table_rows, group_row_target,
+                    &g2, &c1, &c2, &bpg, C1, C2, p1_srcl, p1_off, p1_blk,
+                    p2_dstl, p2_obi, p2_first);
+  if (rc != 0 || g2 != G || c1 > C1 || c2 > C2) return -1;
+  return 0;
+}
+
 void roc_in_degrees(const uint64_t* raw_rows, uint64_t num_nodes,
                     float* deg_out) {
   for (uint64_t v = 0; v < num_nodes; v++)

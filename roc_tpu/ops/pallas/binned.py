@@ -66,6 +66,10 @@ SLOT2 = CH2 // SLOT   # slots per phase-2 chunk
 
 # Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).
 _GROUP_ROW_TARGET = 1 << 21
+# Cap on the dense (source-block x bin) cell table per group — bounds the
+# plan builders' memory on huge sparse graphs to ~256 MiB of int64 cells
+# (the native builder allocates it densely; mirrored there as BN_K2_CAP).
+_K2_CAP = 1 << 25
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,7 +123,34 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
                       group_row_target: int = _GROUP_ROW_TARGET
                       ) -> BinnedPlan:
     """Host-side schedule: sort, slot-pad, and position every edge for both
-    phases.  Pure vectorized NumPy (one lexsort + prefix sums)."""
+    phases.  Big edge lists take the native C++ counting-sort builder
+    (O(E), ~8x the NumPy lexsort path — docs/PERF.md); the vectorized
+    NumPy fallback below is the correctness oracle
+    (tests/test_binned.py::test_native_plan_equals_numpy)."""
+    from roc_tpu import native
+    if len(edge_src) >= (1 << 20) and native.available():
+        (p1_srcl, p1_off, p1_blk, p2_dstl, p2_obi, p2_first,
+         bpg) = native.binned_plan(edge_src, edge_dst, num_rows, table_rows,
+                                   group_row_target)
+        G, C1 = p1_blk.shape
+        C2 = p2_obi.shape[1]
+        return BinnedPlan(
+            p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * CH, 1)),
+            p1_off=jnp.asarray(p1_off),
+            p1_blk=jnp.asarray(p1_blk),
+            p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * CH2, 1)),
+            p2_obi=jnp.asarray(p2_obi),
+            p2_first=jnp.asarray(p2_first),
+            num_rows=num_rows, table_rows=table_rows, bins_per_group=bpg)
+    return _build_binned_plan_numpy(edge_src, edge_dst, num_rows,
+                                    table_rows, group_row_target)
+
+
+def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
+                             num_rows: int, table_rows: int,
+                             group_row_target: int = _GROUP_ROW_TARGET
+                             ) -> BinnedPlan:
+    """The oracle plan builder (vectorized NumPy lexsort + prefix sums)."""
     edge_src = np.asarray(edge_src, np.int64)
     edge_dst = np.asarray(edge_dst, np.int64)
     E = edge_src.shape[0]
@@ -129,7 +160,8 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     bins_per_group = max(min(
         num_bins,
         # bins such that expected group rows ~ group_row_target:
-        int(group_row_target / max(E / num_bins, 1))), 1)
+        int(group_row_target / max(E / num_bins, 1)),
+        _K2_CAP // num_blocks), 1)
     G = -(-num_bins // bins_per_group)
 
     bin_of = edge_dst // RB
@@ -201,10 +233,9 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     stg_slot = cell_stg_slot[slot_cell] + slot_in_cell
 
     # --- materialize -------------------------------------------------------
-    scratch_slot = C2 * SLOT2          # base of the trailing scratch chunk
     p1_srcl = np.zeros((G, C1 * CH), np.int32)
     p1_blk = np.zeros((G, C1), np.int32)
-    p1_off = np.full((G, C1, NSLOT), scratch_slot, np.int32)
+    p1_off = np.full((G, C1, NSLOT), -1, np.int32)   # -1: skip (pad slot)
     g_of_edge = cell_g[edge_cell]
     p1_srcl[g_of_edge, p1_row] = (s_src - s_blk * SB).astype(np.int32)
     if len(gb_uniq):
@@ -256,17 +287,25 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, sem):
         preferred_element_type=jnp.float32).astype(jnp.bfloat16)
 
     # off rides in (8, NSLOT) SMEM blocks; this chunk's row is c % 8.
+    # Pad slots carry offset -1 and are skipped — per-block chunk rounding
+    # makes them ~20-40% of all slots, so not writing them matters.
     def issue(s, _):
-        pltpu.make_async_copy(
-            gbuf.at[pl.ds(s * SLOT, SLOT)],
-            stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)], sem).start()
+        @pl.when(off_ref[c % 8, s] >= 0)
+        def _():
+            pltpu.make_async_copy(
+                gbuf.at[pl.ds(s * SLOT, SLOT)],
+                stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)],
+                sem).start()
         return 0
     jax.lax.fori_loop(0, NSLOT, issue, 0)
 
     def drain(s, _):
-        pltpu.make_async_copy(
-            gbuf.at[pl.ds(s * SLOT, SLOT)],
-            stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)], sem).wait()
+        @pl.when(off_ref[c % 8, s] >= 0)
+        def _():
+            pltpu.make_async_copy(
+                gbuf.at[pl.ds(s * SLOT, SLOT)],
+                stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)],
+                sem).wait()
         return 0
     jax.lax.fori_loop(0, NSLOT, drain, 0)
 
@@ -345,7 +384,7 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False):
     G, C1 = plan.p1_blk.shape
     C2 = plan.p2_obi.shape[1]
     xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, SB) - x.shape[0]), (0, 0)))
-    stg_rows = C2 * CH2 + CH2          # + trailing scratch chunk
+    stg_rows = C2 * CH2
 
     def body(_, gplan):
         srcl, off, blk, dstl, obi, first = gplan

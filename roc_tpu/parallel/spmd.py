@@ -206,8 +206,7 @@ class SpmdTrainer(BaseTrainer):
     def _build_graph_full(self, backend: str) -> ShardedGraphData:
         """Single-host path: whole graph in memory, all P parts built."""
         cfg, ds = self.config, self.dataset
-        if getattr(self, "part", None) is None:
-            self.part = partition_graph(ds.graph, cfg.num_parts)
+        assert self.part is not None, "_setup partitions before building"
         if self._use_edge_shard:
             self.halo = None
             eb_src, eb_dst = edge_block_arrays(ds.graph, self.part.meta)
@@ -311,8 +310,7 @@ class SpmdTrainer(BaseTrainer):
             return False
         # "auto": only sum/avg aggregation is supported, and only skewed
         # partitions benefit (the padded-max tax IS the skew cost).
-        aggrs = {op.attrs["aggr"] for op in self.model.ops
-                 if op.kind == "aggregate"}
+        aggrs = self._model_aggrs()
         if any(op.kind == "gat" for op in self.model.ops):
             return False
         if not aggrs or aggrs - {"sum", "avg"}:

@@ -159,8 +159,7 @@ class BaseTrainer:
             return "xla"
         backend = resolve_backend(cfg.aggregate_backend,
                                   self.dataset.graph.num_edges)
-        aggrs = {op.attrs["aggr"] for op in self.model.ops
-                 if op.kind == "aggregate"}
+        aggrs = self._model_aggrs()
         if backend in ("binned", "matmul") and "sum" not in aggrs:
             if cfg.aggregate_backend != "auto":   # user explicitly chose it
                 print(f"# aggregate_backend={backend} only accelerates sum "
@@ -168,6 +167,12 @@ class BaseTrainer:
                       f"using xla")
             return "xla"
         return backend
+
+    def _model_aggrs(self) -> set:
+        """Aggregation kinds the built model actually uses (backend and
+        edge-shard selection both key off this)."""
+        return {op.attrs["aggr"] for op in self.model.ops
+                if op.kind == "aggregate"}
 
     def _run_step(self, step_key, alpha):
         self.params, self.opt_state, loss = self._train_step(

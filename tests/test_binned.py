@@ -31,6 +31,7 @@ CASES = [
     (100, 100, 0, 64),          # empty edge list
     (513, 513, 1, 8),           # single edge, just past one bin
     (SB + 1, SB + 1, 300, 16),  # two source blocks
+    (3 * RB, 1000, 3000, 16),   # partial last bin group (G=2, bpg=2)
 ]
 
 
@@ -102,3 +103,37 @@ def test_binned_in_trainer():
     assert np.isfinite(losses["binned"])
     assert abs(losses["binned"] - losses["xla"]) < 1e-2 * max(
         abs(losses["xla"]), 1.0)
+
+
+def test_native_plan_equals_numpy():
+    """The C++ counting-sort plan builder must match the NumPy oracle bit
+    for bit (same invariant style as the native halo/chunk builders)."""
+    from roc_tpu import native
+    from roc_tpu.ops.pallas.binned import _build_binned_plan_numpy
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(13)
+    for (n, t, e) in [(700, 700, 5000), (1500, 2000, 30000),
+                      (100, 100, 0), (513, 513, 1), (5000, 4000, 120000),
+                      # partial last group: num_bins=3, bpg=2, G=2 — the
+                      # phantom-bin placeholder path in both builders
+                      (3 * 512, 1000, 3000)]:
+        src = rng.integers(0, t, e).astype(np.int64)
+        dst = rng.integers(0, n, e).astype(np.int64)
+        if e > 100:
+            dst[: e // 4] = 7
+        tgt = 2000 if n == 3 * 512 else 1 << 14
+        ref = _build_binned_plan_numpy(src, dst, n, t, tgt)
+        (p1_srcl, p1_off, p1_blk, p2_dstl, p2_obi, p2_first,
+         bpg) = native.binned_plan(src, dst, n, t, tgt)
+        assert bpg == ref.bins_per_group
+        G, C1 = p1_blk.shape
+        np.testing.assert_array_equal(
+            p1_srcl.reshape(G, C1 * 2048, 1), np.asarray(ref.p1_srcl))
+        np.testing.assert_array_equal(p1_off, np.asarray(ref.p1_off))
+        np.testing.assert_array_equal(p1_blk, np.asarray(ref.p1_blk))
+        C2 = p2_obi.shape[1]
+        np.testing.assert_array_equal(
+            p2_dstl.reshape(G, C2 * 4096, 1), np.asarray(ref.p2_dstl))
+        np.testing.assert_array_equal(p2_obi, np.asarray(ref.p2_obi))
+        np.testing.assert_array_equal(p2_first, np.asarray(ref.p2_first))
