@@ -300,6 +300,33 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
         bwd=build_binned_plan(edge_dst, edge_src, table_rows, num_rows))
 
 
+def pad_binned_plans(plans: "list[BinnedPlans]", min_fwd=(0, 0),
+                     min_bwd=(0, 0)) -> BinnedPlans:
+    """Stack per-shard binned plans to common chunk counts (shard_map
+    needs one static program) — the binned analog of :func:`pad_plans`.
+    All shards share (G, bins_per_group, num_rows, table_rows) by
+    construction: those derive only from the padded shard shapes, which
+    are equal across shards.  ``min_fwd``/``min_bwd`` are (C1, C2) floors
+    — the per-host loader passes allgathered global maxima."""
+    from roc_tpu.ops.pallas.binned import pad_binned_plan
+
+    def stack(side, floors):
+        ps = [getattr(b, side) for b in plans]
+        meta = {(p.num_rows, p.table_rows, p.bins_per_group,
+                 p.p1_blk.shape[0]) for p in ps}
+        assert len(meta) == 1, f"shards disagree on plan geometry: {meta}"
+        C1 = max(max(p.p1_blk.shape[1] for p in ps), floors[0])
+        C2 = max(max(p.p2_obi.shape[1] for p in ps), floors[1])
+        padded = [pad_binned_plan(p, C1, C2) for p in ps]
+        import dataclasses as _dc
+        arrays = {f: jnp.stack([getattr(p, f) for p in padded])
+                  for f in ("p1_srcl", "p1_off", "p1_blk",
+                            "p2_dstl", "p2_obi", "p2_first")}
+        return _dc.replace(padded[0], **arrays)
+
+    return BinnedPlans(fwd=stack("fwd", min_fwd), bwd=stack("bwd", min_bwd))
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
 def scatter_gather_binned(x, plans: BinnedPlans, interpret: bool = False):
     """Sum-aggregation via the binned two-phase kernels (fast path: one bf16

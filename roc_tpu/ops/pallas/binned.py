@@ -399,3 +399,29 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False):
          plan.p2_dstl, plan.p2_obi, plan.p2_first))
     out = outs.reshape(G * plan.bins_per_group * RB, H)
     return out[:plan.num_rows].astype(x.dtype)
+
+
+def pad_binned_plan(plan: BinnedPlan, C1: int, C2: int) -> BinnedPlan:
+    """Pad a plan's chunk counts up to (C1, C2) with canonical no-ops so
+    per-shard plans can be stacked into one static shard_map program
+    (the binned analog of segment_sum.pad_chunks).
+
+    Pad phase-1 chunks: block 0, all slots skipped (-1).  Pad phase-2
+    chunks: revisit the last bin with first=0 and every row masked (RB)."""
+    G, c1 = plan.p1_blk.shape
+    c2 = plan.p2_obi.shape[1]
+    assert C1 >= c1 and C2 >= c2 and C1 % 8 == 0
+    d1, d2 = C1 - c1, C2 - c2
+    if d1 == 0 and d2 == 0:
+        return plan
+    return BinnedPlan(
+        p1_srcl=jnp.pad(plan.p1_srcl, ((0, 0), (0, d1 * CH), (0, 0))),
+        p1_off=jnp.pad(plan.p1_off, ((0, 0), (0, d1), (0, 0)),
+                       constant_values=-1),
+        p1_blk=jnp.pad(plan.p1_blk, ((0, 0), (0, d1))),
+        p2_dstl=jnp.pad(plan.p2_dstl, ((0, 0), (0, d2 * CH2), (0, 0)),
+                        constant_values=RB),
+        p2_obi=jnp.pad(plan.p2_obi, ((0, 0), (0, d2)), mode="edge"),
+        p2_first=jnp.pad(plan.p2_first, ((0, 0), (0, d2))),
+        num_rows=plan.num_rows, table_rows=plan.table_rows,
+        bins_per_group=plan.bins_per_group)

@@ -79,12 +79,15 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
     else:
         src = part.edge_src.astype(np.int32)
     plans = None
-    if backend == "matmul":
+    if backend in ("matmul", "binned"):
         P_, S = part.num_parts, part.shard_nodes
         table_rows = S + P_ * halo.K if halo is not None else P_ * S
-        plans = ops.pad_plans([
-            ops.build_aggregate_plans(src[p], part.edge_dst[p], S, table_rows)
-            for p in range(P_)])
+        build = (ops.build_binned_plans if backend == "binned"
+                 else ops.build_aggregate_plans)
+        per_shard = [build(src[p], part.edge_dst[p], S, table_rows)
+                     for p in range(P_)]
+        plans = (ops.pad_binned_plans(per_shard) if backend == "binned"
+                 else ops.pad_plans(per_shard))
     return ShardedGraphData(
         edge_src=jnp.asarray(src, jnp.int32),
         edge_dst=jnp.asarray(part.edge_dst, jnp.int32),
@@ -144,6 +147,9 @@ def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
     def aggregate(x, aggr):
         table = _exchange(gd_block, use_halo, x)
         if gd_block.plans is not None and aggr == "sum":
+            if gd_block.backend == "binned":
+                return ops.scatter_gather_binned(table, gd_block.plans,
+                                                 interp)
             return ops.scatter_gather_matmul(table, gd_block.plans,
                                              shard_nodes, table.shape[0])
         return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
@@ -242,18 +248,29 @@ class SpmdTrainer(BaseTrainer):
         P_, S = meta.num_parts, meta.shard_nodes
         src = lhalo.edge_src_local if lhalo is not None else local.edge_src
         plans = None
-        if backend == "matmul":
+        if backend in ("matmul", "binned"):
             table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
-            plan_list = [
-                ops.build_aggregate_plans(src[i], local.edge_dst[i], S,
-                                          table_rows)
-                for i in range(len(part_ids))]
-            counts = np.asarray([[p.fwd_obi.shape[0] for p in plan_list],
-                                 [p.bwd_obi.shape[0] for p in plan_list]],
-                                np.int64)
-            gmax = ag(counts.max(axis=1)).max(axis=0)
-            plans = ops.pad_plans(plan_list, min_fwd=int(gmax[0]),
-                                  min_bwd=int(gmax[1]))
+            build = (ops.build_binned_plans if backend == "binned"
+                     else ops.build_aggregate_plans)
+            plan_list = [build(src[i], local.edge_dst[i], S, table_rows)
+                         for i in range(len(part_ids))]
+            if backend == "binned":
+                counts = np.asarray(
+                    [[p.fwd.p1_blk.shape[1] for p in plan_list],
+                     [p.fwd.p2_obi.shape[1] for p in plan_list],
+                     [p.bwd.p1_blk.shape[1] for p in plan_list],
+                     [p.bwd.p2_obi.shape[1] for p in plan_list]], np.int64)
+                gmax = ag(counts.max(axis=1)).max(axis=0)
+                plans = ops.pad_binned_plans(
+                    plan_list, min_fwd=(int(gmax[0]), int(gmax[1])),
+                    min_bwd=(int(gmax[2]), int(gmax[3])))
+            else:
+                counts = np.asarray(
+                    [[p.fwd_obi.shape[0] for p in plan_list],
+                     [p.bwd_obi.shape[0] for p in plan_list]], np.int64)
+                gmax = ag(counts.max(axis=1)).max(axis=0)
+                plans = ops.pad_plans(plan_list, min_fwd=int(gmax[0]),
+                                      min_bwd=int(gmax[1]))
         return ShardedGraphData(
             edge_src=jnp.asarray(src, jnp.int32),
             edge_dst=jnp.asarray(local.edge_dst, jnp.int32),
@@ -338,15 +355,6 @@ class SpmdTrainer(BaseTrainer):
             self.part = partition_graph(ds.graph, P_)
             self._use_edge_shard = self._resolve_edge_shard()
         backend = self._effective_backend()
-        if backend == "binned":
-            # The binned two-phase kernels are single-chip so far; per-shard
-            # edge streams are P-times smaller so the gather tax they attack
-            # is smaller too.  Fall back to the fp32-exact one-hot backend
-            # (sharded binned plans are future work, stacked like pad_plans).
-            if jax.process_index() == 0:
-                print("# aggregate_backend=binned is single-chip; shards "
-                      "use matmul", file=sys.stderr)
-            backend = "matmul"
         gd = self._build_graph_perhost(backend) if cfg.perhost_load \
             else self._build_graph_full(backend)
         if cfg.verbose:

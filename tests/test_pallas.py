@@ -127,9 +127,11 @@ def test_training_pallas_equals_xla_sharded(halo):
     tx = SpmdTrainer(Config(**base), ds, build_gcn(base["layers"], 0.0))
     tp = SpmdTrainer(Config(**base, aggregate_backend="pallas"), ds,
                      build_gcn(base["layers"], 0.0))
+    # "pallas" = the binned kernels (sharded): bf16-rounding tolerance,
+    # same as the single-device variant above.
     for i in range(2):
         lx, lp = float(tx.run_epoch()), float(tp.run_epoch())
-        np.testing.assert_allclose(lp, lx, rtol=1e-4, err_msg=f"epoch {i}")
+        np.testing.assert_allclose(lp, lx, rtol=5e-3, err_msg=f"epoch {i}")
 
 
 def test_empty_graph_plan():
