@@ -107,6 +107,22 @@ def _pad_to(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def binned_viable(num_rows: int, table_rows: int, num_edges: int) -> bool:
+    """Is the binned schedule padding-tolerable for this graph?
+
+    Cells are (source-block x bin) pairs and every non-empty cell pads to
+    SLOT rows; with ~uniform edges the number of touched cells approaches
+    min(E, blocks * bins), so the schedule stays tight only while the
+    average cell holds several SLOTs worth of edges.  Below that (huge
+    sparse graphs: ogbn-products-scale N with modest degree) the padding
+    factor blows up -- measured ~5x at products scale -- and the one-hot
+    matmul backend is the right fast path instead.  The 3*SLOT bound keeps
+    expected padding under ~15%."""
+    num_bins = max(-(-num_rows // RB), 1)
+    num_blocks = max(-(-table_rows // SB), 1)
+    return num_blocks * num_bins * 3 * SLOT <= num_edges
+
+
 def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
     """Exclusive prefix sum of `values` restarted at each change of `keys`
     (keys must be grouped).  Both [n]; returns [n]."""

@@ -72,6 +72,37 @@ jax.tree_util.register_dataclass(
     meta_fields=["backend", "mode"])
 
 
+def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
+                       allgather=None):
+    """Per-shard aggregation plans, stacked to one static program.  Under
+    multihost, ``allgather`` raises the pad floors to the global chunk-count
+    maxima so every process compiles the same program."""
+    if backend == "binned":
+        plan_list = [ops.build_binned_plans(srcs[i], dsts[i], S, table_rows)
+                     for i in range(len(srcs))]
+        floors = ((0, 0), (0, 0))
+        if allgather is not None:
+            counts = np.asarray(
+                [[p.fwd.p1_blk.shape[1] for p in plan_list],
+                 [p.fwd.p2_obi.shape[1] for p in plan_list],
+                 [p.bwd.p1_blk.shape[1] for p in plan_list],
+                 [p.bwd.p2_obi.shape[1] for p in plan_list]], np.int64)
+            g = allgather(counts.max(axis=1)).max(axis=0)
+            floors = ((int(g[0]), int(g[1])), (int(g[2]), int(g[3])))
+        return ops.pad_binned_plans(plan_list, min_fwd=floors[0],
+                                    min_bwd=floors[1])
+    plan_list = [ops.build_aggregate_plans(srcs[i], dsts[i], S, table_rows)
+                 for i in range(len(srcs))]
+    min_fwd = min_bwd = 0
+    if allgather is not None:
+        counts = np.asarray([[p.fwd_obi.shape[0] for p in plan_list],
+                             [p.bwd_obi.shape[0] for p in plan_list]],
+                            np.int64)
+        g = allgather(counts.max(axis=1)).max(axis=0)
+        min_fwd, min_bwd = int(g[0]), int(g[1])
+    return ops.pad_plans(plan_list, min_fwd=min_fwd, min_bwd=min_bwd)
+
+
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
                 backend: str = "xla") -> ShardedGraphData:
     if halo is not None:
@@ -82,12 +113,8 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
     if backend in ("matmul", "binned"):
         P_, S = part.num_parts, part.shard_nodes
         table_rows = S + P_ * halo.K if halo is not None else P_ * S
-        build = (ops.build_binned_plans if backend == "binned"
-                 else ops.build_aggregate_plans)
-        per_shard = [build(src[p], part.edge_dst[p], S, table_rows)
-                     for p in range(P_)]
-        plans = (ops.pad_binned_plans(per_shard) if backend == "binned"
-                 else ops.pad_plans(per_shard))
+        plans = _build_shard_plans(backend, src, part.edge_dst, S,
+                                   table_rows)
     return ShardedGraphData(
         edge_src=jnp.asarray(src, jnp.int32),
         edge_dst=jnp.asarray(part.edge_dst, jnp.int32),
@@ -250,27 +277,8 @@ class SpmdTrainer(BaseTrainer):
         plans = None
         if backend in ("matmul", "binned"):
             table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
-            build = (ops.build_binned_plans if backend == "binned"
-                     else ops.build_aggregate_plans)
-            plan_list = [build(src[i], local.edge_dst[i], S, table_rows)
-                         for i in range(len(part_ids))]
-            if backend == "binned":
-                counts = np.asarray(
-                    [[p.fwd.p1_blk.shape[1] for p in plan_list],
-                     [p.fwd.p2_obi.shape[1] for p in plan_list],
-                     [p.bwd.p1_blk.shape[1] for p in plan_list],
-                     [p.bwd.p2_obi.shape[1] for p in plan_list]], np.int64)
-                gmax = ag(counts.max(axis=1)).max(axis=0)
-                plans = ops.pad_binned_plans(
-                    plan_list, min_fwd=(int(gmax[0]), int(gmax[1])),
-                    min_bwd=(int(gmax[2]), int(gmax[3])))
-            else:
-                counts = np.asarray(
-                    [[p.fwd_obi.shape[0] for p in plan_list],
-                     [p.bwd_obi.shape[0] for p in plan_list]], np.int64)
-                gmax = ag(counts.max(axis=1)).max(axis=0)
-                plans = ops.pad_plans(plan_list, min_fwd=int(gmax[0]),
-                                      min_bwd=int(gmax[1]))
+            plans = _build_shard_plans(backend, src, local.edge_dst, S,
+                                       table_rows, allgather=ag)
         return ShardedGraphData(
             edge_src=jnp.asarray(src, jnp.int32),
             edge_dst=jnp.asarray(local.edge_dst, jnp.int32),

@@ -67,13 +67,22 @@ def device_sync(x):
 # index (measured ~6.5 s/aggregation at Reddit scale on v5e; see
 # roc_tpu/ops/aggregate.py).  CPU/GPU scatters are fine as-is.
 AUTO_MATMUL_EDGES = 1 << 20
+# Flip to True once the binned kernels are measured faster on hardware
+# (pending BENCH_r02; the CPU-side evidence is in docs/PERF.md+GOLDEN.md).
+AUTO_BINNED = False
 
 
-def resolve_backend(backend: str, num_edges: int) -> str:
+def resolve_backend(backend: str, num_edges: int, num_rows: int = 0,
+                    table_rows: int = 0) -> str:
     if backend == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        return "matmul" if (on_tpu and num_edges >= AUTO_MATMUL_EDGES) \
-            else "xla"
+        if not (on_tpu and num_edges >= AUTO_MATMUL_EDGES):
+            return "xla"
+        from roc_tpu.ops.pallas.binned import binned_viable
+        if AUTO_BINNED and num_rows and binned_viable(num_rows, table_rows,
+                                                      num_edges):
+            return "binned"
+        return "matmul"
     if backend == "pallas":
         # Round-1's blocked-CSR kernel cannot lower on hardware (per-row DMA
         # slices of tiled HBM refs; docs/PERF.md); "pallas" now names the
@@ -83,7 +92,8 @@ def resolve_backend(backend: str, num_edges: int) -> str:
 
 
 def dense_graph_data(graph, backend: str = "xla") -> DenseGraphData:
-    backend = resolve_backend(backend, graph.num_edges)
+    backend = resolve_backend(backend, graph.num_edges, graph.num_nodes,
+                              graph.num_nodes)
     plans = None
     if backend == "matmul":
         plans = ops.build_aggregate_plans(
@@ -157,8 +167,9 @@ class BaseTrainer:
                 print(f"# -edge-shard ignores aggregate_backend="
                       f"{cfg.aggregate_backend}; using xla")
             return "xla"
-        backend = resolve_backend(cfg.aggregate_backend,
-                                  self.dataset.graph.num_edges)
+        g = self.dataset.graph
+        backend = resolve_backend(cfg.aggregate_backend, g.num_edges,
+                                  g.num_nodes, g.num_nodes)
         aggrs = self._model_aggrs()
         if backend in ("binned", "matmul") and "sum" not in aggrs:
             if cfg.aggregate_backend != "auto":   # user explicitly chose it
@@ -241,7 +252,7 @@ class BaseTrainer:
         return self
 
     # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
-    def save_checkpoint(self, path: str):
+    def save_checkpoint(self, path: str, extra=None):
         from roc_tpu.train import checkpoint
         # Params/opt state are replicated: every process holds the same
         # values, so only process 0 writes (P identical writers on shared
@@ -250,7 +261,7 @@ class BaseTrainer:
         # that is still mid-rename.
         if jax.process_index() == 0:
             checkpoint.save(path, self.params, self.opt_state, self.epoch,
-                            self.optimizer.alpha)
+                            self.optimizer.alpha, extra=extra)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("roc_tpu_ckpt_saved")

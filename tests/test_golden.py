@@ -48,7 +48,7 @@ def test_golden_reddit_small_curve():
 def test_golden_cora_curve_binned_backend():
     """The binned backend's designed bf16 rounding must not move the golden
     curve (docs/GOLDEN.md records the full metric lines: accuracy counts
-    are identical to fp32 at every checkpoint)."""
+    agree with fp32 to within +-1 sample at every checkpoint)."""
     ds = datasets.get("cora", seed=1)
     cfg = Config(layers=[1433, 16, 7], num_epochs=20, learning_rate=0.01,
                  weight_decay=5e-4, dropout_rate=0.5, seed=1,
