@@ -294,36 +294,65 @@ def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
 # Phase-1 kernel: one-hot expand + slot-scatter to staging.
 # ---------------------------------------------------------------------------
 
-def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, sem):
+def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
+               sems):
+    """Double-buffered: the slot DMAs issued for chunk c drain at chunk
+    c+2 (same gbuf parity), so the writes of one chunk overlap the next
+    chunk's one-hot matmul.  ``offbuf`` keeps each parity's issued offsets
+    (the wait must reconstruct the same descriptors); pad slots carry
+    offset -1 and are skipped — per-block chunk rounding makes them
+    20-40% of all slots, so not writing them matters."""
     c = pl.program_id(0)
+    par = c % 2
+
+    def drain(s, _):
+        @pl.when(offbuf[par, s] >= 0)
+        def _():
+            pltpu.make_async_copy(
+                gbuf.at[par].at[pl.ds(s * SLOT, SLOT)],
+                stg_ref.at[pl.ds(offbuf[par, s] * SLOT, SLOT)],
+                sems.at[par]).wait()
+        return 0
+
+    @pl.when(c >= 2)            # chunk c-2 used this parity's buffers
+    def _():
+        jax.lax.fori_loop(0, NSLOT, drain, 0)
+
     lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
     t = (lane == srcl_ref[:]).astype(jnp.bfloat16)
-    gbuf[:] = jax.lax.dot_general(
+    gbuf[par] = jax.lax.dot_general(
         t, x_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(jnp.bfloat16)
 
     # off rides in (8, NSLOT) SMEM blocks; this chunk's row is c % 8.
-    # Pad slots carry offset -1 and are skipped — per-block chunk rounding
-    # makes them ~20-40% of all slots, so not writing them matters.
     def issue(s, _):
+        offbuf[par, s] = off_ref[c % 8, s]
         @pl.when(off_ref[c % 8, s] >= 0)
         def _():
             pltpu.make_async_copy(
-                gbuf.at[pl.ds(s * SLOT, SLOT)],
+                gbuf.at[par].at[pl.ds(s * SLOT, SLOT)],
                 stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)],
-                sem).start()
+                sems.at[par]).start()
         return 0
     jax.lax.fori_loop(0, NSLOT, issue, 0)
 
-    def drain(s, _):
-        @pl.when(off_ref[c % 8, s] >= 0)
+    # Last chunk: drain everything still in flight (both parities) —
+    # pallas does not wait for manual DMAs at grid end.
+    @pl.when(c == pl.num_programs(0) - 1)
+    def _():
+        jax.lax.fori_loop(0, NSLOT, drain, 0)
+
+        @pl.when(c >= 1)
         def _():
-            pltpu.make_async_copy(
-                gbuf.at[pl.ds(s * SLOT, SLOT)],
-                stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)],
-                sem).wait()
-        return 0
-    jax.lax.fori_loop(0, NSLOT, drain, 0)
+            def drain_other(s, _):
+                @pl.when(offbuf[1 - par, s] >= 0)
+                def _():
+                    pltpu.make_async_copy(
+                        gbuf.at[1 - par].at[pl.ds(s * SLOT, SLOT)],
+                        stg_ref.at[pl.ds(offbuf[1 - par, s] * SLOT, SLOT)],
+                        sems.at[1 - par]).wait()
+                return 0
+            jax.lax.fori_loop(0, NSLOT, drain_other, 0)
 
 
 @partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret"))
@@ -340,8 +369,9 @@ def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
             pl.BlockSpec((SB, H), lambda c, blk: (blk[c], 0)),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.VMEM((CH, H), jnp.bfloat16),
-                        pltpu.SemaphoreType.DMA],
+        scratch_shapes=[pltpu.VMEM((2, CH, H), jnp.bfloat16),
+                        pltpu.SMEM((2, NSLOT), jnp.int32),
+                        pltpu.SemaphoreType.DMA((2,))],
     )
     return pl.pallas_call(
         _p1_kernel, grid_spec=grid_spec,
