@@ -305,18 +305,20 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
     c = pl.program_id(0)
     par = c % 2
 
-    def drain(s, _):
-        @pl.when(offbuf[par, s] >= 0)
-        def _():
-            pltpu.make_async_copy(
-                gbuf.at[par].at[pl.ds(s * SLOT, SLOT)],
-                stg_ref.at[pl.ds(offbuf[par, s] * SLOT, SLOT)],
-                sems.at[par]).wait()
-        return 0
+    def drain_parity(p):
+        def drain(s, _):
+            @pl.when(offbuf[p, s] >= 0)
+            def _():
+                pltpu.make_async_copy(
+                    gbuf.at[p].at[pl.ds(s * SLOT, SLOT)],
+                    stg_ref.at[pl.ds(offbuf[p, s] * SLOT, SLOT)],
+                    sems.at[p]).wait()
+            return 0
+        jax.lax.fori_loop(0, NSLOT, drain, 0)
 
     @pl.when(c >= 2)            # chunk c-2 used this parity's buffers
     def _():
-        jax.lax.fori_loop(0, NSLOT, drain, 0)
+        drain_parity(par)
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
     t = (lane == srcl_ref[:]).astype(jnp.bfloat16)
@@ -340,19 +342,11 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
     # pallas does not wait for manual DMAs at grid end.
     @pl.when(c == pl.num_programs(0) - 1)
     def _():
-        jax.lax.fori_loop(0, NSLOT, drain, 0)
+        drain_parity(par)
 
         @pl.when(c >= 1)
         def _():
-            def drain_other(s, _):
-                @pl.when(offbuf[1 - par, s] >= 0)
-                def _():
-                    pltpu.make_async_copy(
-                        gbuf.at[1 - par].at[pl.ds(s * SLOT, SLOT)],
-                        stg_ref.at[pl.ds(offbuf[1 - par, s] * SLOT, SLOT)],
-                        sems.at[1 - par]).wait()
-                return 0
-            jax.lax.fori_loop(0, NSLOT, drain_other, 0)
+            drain_parity(1 - par)
 
 
 @partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret"))
