@@ -34,6 +34,14 @@ def main(argv=None) -> int:
         print("error: -perhost requires -file and -parts > 1",
               file=sys.stderr)
         return 2
+    if cfg.exchange == "ring" and cfg.edge_shard in (True, "on"):
+        print("error: -exchange ring and -edge-shard are mutually "
+              "exclusive distribution strategies", file=sys.stderr)
+        return 2
+    if cfg.exchange == "ring" and cfg.model == "gat":
+        print("error: -exchange ring cannot serve GAT attention (needs a "
+              "materialized source table); use -exchange halo", file=sys.stderr)
+        return 2
     if cfg.edge_shard in (True, "on") and (
             cfg.num_parts < 2 or cfg.perhost_load or cfg.model == "gat"
             or cfg.aggr in ("max", "min")):

@@ -88,12 +88,17 @@ def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
                                   edge_dst, num_segments=num_nodes,
                                   indices_are_sorted=True)
         return s / jnp.maximum(cnt, 1.0)[:, None]
-    if aggr == "max":
-        return jax.ops.segment_max(gathered, edge_dst, num_segments=num_nodes,
-                                   indices_are_sorted=True)
-    if aggr == "min":
-        return jax.ops.segment_min(gathered, edge_dst, num_segments=num_nodes,
-                                   indices_are_sorted=True)
+    if aggr in ("max", "min"):
+        seg = jax.ops.segment_max if aggr == "max" else jax.ops.segment_min
+        out = seg(gathered, edge_dst, num_segments=num_nodes,
+                  indices_are_sorted=True)
+        # Empty neighborhoods fill with the segment identity (+-inf), which
+        # NaN-poisons any later linear layer (inf * 0 weight).  Zero exactly
+        # those — the zero-preserving convention the shard-padding machinery
+        # relies on (graph/partition.py).  Matching the identity (not
+        # isfinite) keeps genuine NaN blow-ups visible.
+        empty = jnp.isneginf(out) if aggr == "max" else jnp.isposinf(out)
+        return jnp.where(empty, 0, out)
     raise ValueError(f"unknown aggr {aggr!r}")
 
 

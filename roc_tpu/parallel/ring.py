@@ -1,0 +1,69 @@
+"""Ring exchange (v2) for vertex-sharded aggregation.
+
+The third exchange mode next to all_gather (v0, the reference's
+full-replication semantics — scattergather.cc:69-73 reads the WHOLE node
+tensor per GPU) and halo all_to_all (v1).  Shards rotate around the mesh
+with `lax.ppermute` — the literal ring-attention pattern applied to the
+framework's context axis (SURVEY §5.7: the vertex-shard axis IS the
+sequence axis) — and every shard aggregates the in-edges sourced at the
+visiting shard before passing it on:
+
+    step k: shard p holds x of owner q = (p - k) mod P
+            acc <- combine(acc, aggregate(edges of p with src-owner q))
+            buf <- ppermute(buf, p -> p+1)
+
+Comms volume equals all_gather (each shard's rows traverse the full ring)
+but peak memory is TWO [S, H] buffers instead of the [P*S, H] table, and
+XLA overlaps each hop with the step's aggregation — the property that
+makes ring attention scale to long sequences applies unchanged.  Use it
+when the halo is dense (halo rows ~ all rows, so v1 degenerates to v0)
+and P*S*H no longer fits comfortably next to the model.
+
+Host side, each shard's in-edge list is regrouped by source owner
+(stable, so dst stays ascending within a group — sorted segment sums) and
+padded to the global max group size; pad slots carry dst = S, a sentinel
+row the aggregation drops.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from roc_tpu.graph.partition import Partition
+
+
+class RingMaps(NamedTuple):
+    """Per-(shard, source-owner) edge groups, padded to a common size.
+
+    ring_src [P, P, Eo] int32: source row LOCAL to its owner (pad: 0)
+    ring_dst [P, P, Eo] int32: dest row local to the shard, ascending
+                               within each group (pad: S, dropped)
+    """
+    ring_src: np.ndarray
+    ring_dst: np.ndarray
+
+
+def build_ring_groups(part: Partition) -> RingMaps:
+    """Group every shard's edges by source owner (vectorized NumPy)."""
+    P, S = part.num_parts, part.shard_nodes
+    E = part.edge_src.shape[1]
+    owner = (part.edge_src // S).astype(np.int64)            # [P, E]
+    counts = np.zeros((P, P), np.int64)
+    rows = np.repeat(np.arange(P), E)
+    np.add.at(counts, (rows, owner.reshape(-1)), 1)
+    Eo = max(int(counts.max()), 1)
+
+    ring_src = np.zeros((P, P, Eo), np.int32)
+    ring_dst = np.full((P, P, Eo), S, np.int32)
+    # stable grouping: position of each edge within its (p, owner) group
+    order = np.argsort(owner, axis=1, kind="stable")          # [P, E]
+    for p in range(P):
+        o = owner[p, order[p]]
+        starts = np.searchsorted(o, np.arange(P))
+        pos = np.arange(E) - starts[o]
+        ring_src[p, o, pos] = (part.edge_src[p, order[p]] % S).astype(
+            np.int32)
+        ring_dst[p, o, pos] = part.edge_dst[p, order[p]].astype(np.int32)
+    return RingMaps(ring_src=ring_src, ring_dst=ring_dst)

@@ -60,6 +60,8 @@ class ShardedGraphData:
     edge_dst: jnp.ndarray            # [P, E] int32, ascending per shard
     in_degree: jnp.ndarray           # [P, S] float32
     send_idx: Optional[jnp.ndarray]  # [P, P, K] int32, halo mode only
+    ring_src: Optional[jnp.ndarray] = None   # [P, P, Eo] int32, ring mode
+    ring_dst: Optional[jnp.ndarray] = None   # [P, P, Eo] int32, ring mode
     plans: object = None             # stacked AggregatePlans ([P, ...] axes)
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
     mode: str = dataclasses.field(default="vertex",
@@ -68,7 +70,8 @@ class ShardedGraphData:
 
 jax.tree_util.register_dataclass(
     ShardedGraphData,
-    data_fields=["edge_src", "edge_dst", "in_degree", "send_idx", "plans"],
+    data_fields=["edge_src", "edge_dst", "in_degree", "send_idx",
+                 "ring_src", "ring_dst", "plans"],
     meta_fields=["backend", "mode"])
 
 
@@ -125,10 +128,11 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
     )
 
 
-def _exchange(gd_block, use_halo: bool, x):
+def _exchange(gd_block, exchange: str, x):
     """Materialize the per-shard source table for a [S, H] local tensor:
-    local rows ++ halo rows (one all_to_all) or the all-gathered tensor."""
-    if use_halo:
+    local rows ++ halo rows (one all_to_all) or the all-gathered tensor.
+    (Ring mode never builds a table — see _ring_aggregate.)"""
+    if exchange == "halo":
         send = jnp.take(x, gd_block.send_idx, axis=0)           # [P, K, H]
         recv = jax.lax.all_to_all(send, PARTS_AXIS,
                                   split_axis=0, concat_axis=0)
@@ -137,7 +141,62 @@ def _exchange(gd_block, use_halo: bool, x):
     return jax.lax.all_gather(x, PARTS_AXIS, tiled=True)        # [P*S, H]
 
 
-def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
+def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
+    """Rotate shards around the mesh with ppermute, aggregating each
+    visiting shard's contribution (see parallel/ring.py).  One [S, H]
+    buffer in flight; XLA overlaps each hop with the step's aggregation."""
+    P_ = gd_block.ring_src.shape[0]
+    S = shard_nodes
+    if aggr not in ("sum", "avg", "max", "min"):
+        raise ValueError(f"unknown aggr {aggr!r}")
+    p = jax.lax.axis_index(PARTS_AXIS)
+    base = "sum" if aggr in ("sum", "avg") else aggr
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+    def step(carry, k):
+        buf, acc = carry
+        owner = jax.lax.rem(p - k + P_, P_)       # whose rows buf holds
+        es = jnp.take(gd_block.ring_src, owner, axis=0)       # [Eo]
+        ed = jnp.take(gd_block.ring_dst, owner, axis=0)       # [Eo], pad=S
+        gathered = jnp.take(buf, es, axis=0)
+        if base == "sum":
+            part = jax.ops.segment_sum(gathered, ed, num_segments=S + 1,
+                                       indices_are_sorted=True)[:S]
+        elif base == "max":
+            # raw segment op: per-step empties must stay -inf so the
+            # cross-step maximum cannot be polluted by a 0 fill
+            part = jax.ops.segment_max(gathered, ed, num_segments=S + 1,
+                                       indices_are_sorted=True)[:S]
+        else:
+            part = jax.ops.segment_min(gathered, ed, num_segments=S + 1,
+                                       indices_are_sorted=True)[:S]
+        if base == "sum":
+            acc = acc + part
+        elif base == "max":
+            acc = jnp.maximum(acc, part)
+        else:
+            acc = jnp.minimum(acc, part)
+        buf = jax.lax.ppermute(buf, PARTS_AXIS, perm)
+        return (buf, acc), None
+
+    H = x.shape[-1]
+    # `+ 0 * x[:1, :1]`: the scan carry must share x's device-varying vma
+    # annotation under shard_map (same workaround as the matmul acc below).
+    init = jnp.full((S, H), {"sum": 0.0, "max": -jnp.inf, "min": jnp.inf}
+                    [base], x.dtype) + 0 * x[:1, :1]
+    (_, acc), _ = jax.lax.scan(step, (x, init), jnp.arange(P_))
+    if aggr == "avg":
+        acc = acc / jnp.maximum(gd_block.in_degree, 1.0)[:, None]
+    if base in ("max", "min"):
+        # rows with no in-edges anywhere stayed at the segment identity:
+        # zero exactly those (convention shared with ops.scatter_gather;
+        # NaN from genuine divergence must still propagate)
+        empty = jnp.isneginf(acc) if base == "max" else jnp.isposinf(acc)
+        acc = jnp.where(empty, 0, acc)
+    return acc
+
+
+def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
     """Build the per-shard GraphCtx (runs inside shard_map; gd_block fields
     already have the leading parts-axis block squeezed)."""
     from roc_tpu.train.driver import pallas_interpret
@@ -171,8 +230,20 @@ def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
         return GraphCtx(aggregate=aggregate_edge,
                         in_degree=gd_block.in_degree, attend=attend_edge)
 
+    if gd_block.mode == "ring":
+        def aggregate_ring(x, aggr):
+            return _ring_aggregate(gd_block, shard_nodes, x, aggr)
+
+        def attend_ring(h, a_src, a_dst, slope):
+            raise NotImplementedError(
+                "GAT attention needs a materialized source table; use "
+                "-exchange halo or allgather")
+
+        return GraphCtx(aggregate=aggregate_ring,
+                        in_degree=gd_block.in_degree, attend=attend_ring)
+
     def aggregate(x, aggr):
-        table = _exchange(gd_block, use_halo, x)
+        table = _exchange(gd_block, exchange, x)
         if gd_block.plans is not None and aggr == "sum":
             if gd_block.backend == "binned":
                 return ops.scatter_gather_binned(table, gd_block.plans,
@@ -184,7 +255,8 @@ def _shard_gctx(gd_block, shard_nodes: int, use_halo: bool) -> GraphCtx:
 
     def attend(h, a_src, a_dst, slope):
         kk, fd = h.shape[1], h.shape[2]
-        table = _exchange(gd_block, use_halo, h.reshape(h.shape[0], kk * fd))
+        table = _exchange(gd_block, exchange,
+                          h.reshape(h.shape[0], kk * fd))
         return ops.gat_attend(h, table.reshape(-1, kk, fd), edge_src,
                               edge_dst, shard_nodes, a_src, a_dst, slope)
 
@@ -249,7 +321,20 @@ class SpmdTrainer(BaseTrainer):
                 edge_dst=jnp.asarray(eb_dst, jnp.int32),
                 in_degree=jnp.asarray(self.part.in_degree, jnp.float32),
                 send_idx=None, plans=None, backend=backend, mode="edge")
-        self.halo = build_halo_maps(self.part) if cfg.halo else None
+        if self._exchange_mode == "ring":
+            from roc_tpu.parallel.ring import build_ring_groups
+            self.halo = None
+            rm = build_ring_groups(self.part)
+            return ShardedGraphData(
+                edge_src=jnp.asarray(self.part.edge_src, jnp.int32),
+                edge_dst=jnp.asarray(self.part.edge_dst, jnp.int32),
+                in_degree=jnp.asarray(self.part.in_degree, jnp.float32),
+                send_idx=None,
+                ring_src=jnp.asarray(rm.ring_src),
+                ring_dst=jnp.asarray(rm.ring_dst),
+                plans=None, backend=backend, mode="ring")
+        self.halo = build_halo_maps(self.part) \
+            if self._exchange_mode == "halo" else None
         return shard_graph(self.part, self.halo, backend)
 
     def _build_graph_perhost(self, backend: str) -> ShardedGraphData:
@@ -269,8 +354,8 @@ class SpmdTrainer(BaseTrainer):
         self.part = meta
         part_ids = self._local_part_ids()
         local = shard_load.load_local_shards(path, meta, part_ids)
-        lhalo = shard_load.build_halo_local(meta, local, ag) if cfg.halo \
-            else None
+        lhalo = shard_load.build_halo_local(meta, local, ag) \
+            if self._exchange_mode == "halo" else None
         self.halo = lhalo
         P_, S = meta.num_parts, meta.shard_nodes
         src = lhalo.edge_src_local if lhalo is not None else local.edge_src
@@ -333,6 +418,10 @@ class SpmdTrainer(BaseTrainer):
             return True
         if es in (False, None, "off"):
             return False
+        if self._exchange_mode == "ring":
+            # an explicit -exchange ring is a deliberate distribution
+            # choice; auto edge-shard must not silently override it
+            return False
         # "auto": only sum/avg aggregation is supported, and only skewed
         # partitions benefit (the padded-max tax IS the skew cost).
         aggrs = self._model_aggrs()
@@ -355,6 +444,12 @@ class SpmdTrainer(BaseTrainer):
         P_ = cfg.num_parts
         self.mesh = make_mesh(P_)
         self.part = None
+        self._exchange_mode = cfg.exchange_mode()
+        if self._exchange_mode == "ring" and cfg.perhost_load:
+            if jax.process_index() == 0:
+                print("# -exchange ring is incompatible with -perhost; "
+                      "using halo", file=sys.stderr)
+            self._exchange_mode = "halo"
         if cfg.perhost_load:
             if cfg.edge_shard in (True, "on") and jax.process_index() == 0:
                 print("# -edge-shard is incompatible with -perhost; using "
@@ -362,7 +457,22 @@ class SpmdTrainer(BaseTrainer):
         else:
             self.part = partition_graph(ds.graph, P_)
             self._use_edge_shard = self._resolve_edge_shard()
+        if self._use_edge_shard and self._exchange_mode == "ring":
+            if jax.process_index() == 0:
+                print("# -edge-shard on overrides -exchange ring (edge "
+                      "blocks have their own psum_scatter exchange)",
+                      file=sys.stderr)
+            self._exchange_mode = "halo"   # ignored by the edge path
         backend = self._effective_backend()
+        if self._exchange_mode == "ring" and backend != "xla":
+            # ring aggregates incrementally per visiting shard — the
+            # plan backends need one materialized source table
+            if cfg.aggregate_backend not in ("auto", "xla") and \
+                    jax.process_index() == 0:
+                print(f"# -exchange ring ignores aggregate_backend="
+                      f"{cfg.aggregate_backend}; using xla", file=sys.stderr)
+            backend = "xla"
+
         gd = self._build_graph_perhost(backend) if cfg.perhost_load \
             else self._build_graph_full(backend)
         if cfg.verbose:
@@ -399,13 +509,13 @@ class SpmdTrainer(BaseTrainer):
         self.opt_state = jax.device_put(self.optimizer.init(self.params),
                                         repl_spec)
 
-        use_halo = self.halo is not None
+        exchange = self._exchange_mode
         optimizer = self.optimizer
         # pallas_call can't annotate vma yet; the matmul backend is plain XLA
         check_vma = gd.plans is None or backend == "matmul"
 
         def local_loss(params, x, labels, mask, gd_block, key):
-            gctx = _shard_gctx(gd_block, S, use_halo)
+            gctx = _shard_gctx(gd_block, S, exchange)
             return model.loss(params, x, labels, mask, gctx, key=key,
                               train=True)
 
@@ -435,7 +545,7 @@ class SpmdTrainer(BaseTrainer):
                  out_specs=P())
         def eval_shard(params, x, labels, mask, gd):
             gd = _squeeze_gd(gd)
-            gctx = _shard_gctx(gd, S, use_halo)
+            gctx = _shard_gctx(gd, S, exchange)
             logits = model.apply(params, x, gctx, train=False)
             m = ops.perf_metrics(logits, labels, mask)
             return jax.tree.map(lambda v: jax.lax.psum(v, PARTS_AXIS), m)
@@ -445,7 +555,7 @@ class SpmdTrainer(BaseTrainer):
                  out_specs=P(PARTS_AXIS))
         def logits_shard(params, x, gd):
             gd = _squeeze_gd(gd)
-            gctx = _shard_gctx(gd, S, use_halo)
+            gctx = _shard_gctx(gd, S, exchange)
             return model.apply(params, x, gctx, train=False)
 
         self._train_step = jax.jit(step_shard, donate_argnums=(0, 1))

@@ -52,6 +52,9 @@ class Config:
     lazy_load: bool = False       # memmap features / defer one-hot labels
                                   # (sharded host loading for huge graphs)
     halo: bool = True             # v1 halo exchange vs v0 all_gather
+    exchange: str = ""            # halo | allgather | ring (empty: derive
+                                  # from `halo`; ring = ppermute rotation,
+                                  # memory-bounded — parallel/ring.py)
     check_sharding: bool = False  # validate sharded == single-device first
     profile_dir: str = ""         # write a jax.profiler trace of epochs 3-5
     multihost: bool = False       # jax.distributed.initialize() before run
@@ -63,6 +66,10 @@ class Config:
                                   # padded-max tax exceeds ~30% (docs/PERF.md
                                   # rule of thumb); True/"on", False/"off"
                                   # force it
+
+    def exchange_mode(self) -> str:
+        """Effective exchange mode ('halo' | 'allgather' | 'ring')."""
+        return self.exchange or ("halo" if self.halo else "allgather")
 
 
 def parse_args(argv: List[str]) -> Config:
@@ -96,6 +103,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-bf16", dest="use_bf16", action="store_true")
     p.add_argument("-lazy", dest="lazy_load", action="store_true")
     p.add_argument("-no-halo", dest="halo", action="store_false")
+    p.add_argument("-exchange", dest="exchange", default="",
+                   choices=["", "halo", "allgather", "ring"])
     p.add_argument("-check-sharding", dest="check_sharding",
                    action="store_true")
     p.add_argument("-profile", dest="profile_dir", default="")

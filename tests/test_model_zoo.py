@@ -48,14 +48,20 @@ def test_deep_residual_gcn_learns():
     assert val_acc(jax.device_get(tr.evaluate())) > 0.5
 
 
-@pytest.mark.parametrize("name", ["sage", "gin"])
+@pytest.mark.parametrize("name", ["sage", "gin", "sage-max"])
 def test_zoo_models_sharded_match_single(name):
+    # sage-max: shard pad rows have no edges; max aggregation must fill
+    # them with 0, not -inf (which NaN-poisons the next linear layer)
+    aggr = "max" if name == "sage-max" else None
+    name = name.split("-")[0]
     ds = small_ds(seed=47)
     layers = [ds.in_dim, 8, ds.num_classes]
+    kw = {"aggr": aggr} if aggr else {}
     mk = lambda parts: Config(layers=layers, num_epochs=3, dropout_rate=0.0,
-                              eval_every=10**9, num_parts=parts, halo=True)
-    ref = Trainer(mk(1), ds, build_model(name, layers, 0.0))
-    sp = SpmdTrainer(mk(4), ds, build_model(name, layers, 0.0))
+                              eval_every=10**9, num_parts=parts, halo=True,
+                              **kw)
+    ref = Trainer(mk(1), ds, build_model(name, layers, 0.0, **kw))
+    sp = SpmdTrainer(mk(4), ds, build_model(name, layers, 0.0, **kw))
     for i in range(3):
         np.testing.assert_allclose(float(sp.run_epoch()),
                                    float(ref.run_epoch()), rtol=2e-3,

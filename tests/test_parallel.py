@@ -131,3 +131,62 @@ def test_fast_halo_builders_equal_reference(parts):
         assert fast.halo_rows_total == ref.halo_rows_total
         np.testing.assert_array_equal(fast.send_idx, ref.send_idx)
         np.testing.assert_array_equal(fast.edge_src_local, ref.edge_src_local)
+
+
+def test_ring_exchange_matches_halo_and_single_device():
+    """-exchange ring (ppermute rotation, parallel/ring.py) must train
+    equal to the halo and single-device paths up to fp32 reassociation
+    (partial sums accumulate per visiting shard)."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("ring", 260, 4.0, 8, 4, n_train=50, n_val=50,
+                            n_test=50, seed=6)
+    layers = [8, 8, 4]
+    base = dict(layers=layers, num_epochs=3, dropout_rate=0.0,
+                eval_every=10 ** 9, edge_shard="off")
+    t1 = Trainer(Config(**base), ds, build_gcn(layers, 0.0))
+    th = SpmdTrainer(Config(**base, num_parts=4, halo=True), ds,
+                     build_gcn(layers, 0.0))
+    tr = SpmdTrainer(Config(**base, num_parts=4, exchange="ring"), ds,
+                     build_gcn(layers, 0.0))
+    assert tr.gdata.mode == "ring" and tr.gdata.ring_src is not None
+    # first epoch tight; later epochs loose (fp32 reassociation amplifies
+    # chaotically across epochs — same policy as the sage test below)
+    for i, rtol in enumerate((2e-5, 5e-3, 5e-3)):
+        l1 = float(t1.run_epoch())
+        lh = float(th.run_epoch())
+        lr = float(tr.run_epoch())
+        np.testing.assert_allclose(lr, lh, rtol=rtol, err_msg=f"epoch {i}")
+        np.testing.assert_allclose(lr, l1, rtol=rtol, err_msg=f"epoch {i}")
+
+
+def test_ring_exchange_sage_avg_and_max():
+    """Ring mode supports avg (sum/degree) and max (max-of-maxes across
+    visiting shards)."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_sage
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("ringsage", 220, 4.0, 8, 4, n_train=40,
+                            n_val=40, n_test=40, seed=7)
+    layers = [8, 8, 4]
+    for aggr in ("avg", "max"):
+        base = dict(layers=layers, num_epochs=2, dropout_rate=0.0,
+                    eval_every=10 ** 9, edge_shard="off", aggr=aggr,
+                    model="sage")
+        t1 = Trainer(Config(**base), ds, build_sage(layers, 0.0, aggr=aggr))
+        tr = SpmdTrainer(Config(**base, num_parts=4, exchange="ring"), ds,
+                         build_sage(layers, 0.0, aggr=aggr))
+        # op-level ring == single-device to ~2e-6 (verified directly);
+        # across epochs fp32 reassociation amplifies chaotically, so only
+        # the first epoch is tight.
+        for i, rtol in enumerate((2e-5, 5e-3)):
+            l1, lr = float(t1.run_epoch()), float(tr.run_epoch())
+            np.testing.assert_allclose(lr, l1, rtol=rtol,
+                                       err_msg=f"{aggr} epoch {i}")
