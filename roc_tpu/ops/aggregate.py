@@ -305,6 +305,18 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
         bwd=build_binned_plan(edge_dst, edge_src, table_rows, num_rows))
 
 
+def matmul_precision(aggregate_precision: str) -> str:
+    """Map the config-level precision name to the dot_general precision,
+    rejecting anything but the two supported spellings (a silent fallthrough
+    to the fast path would drop the fp32-exact guarantee)."""
+    if aggregate_precision == "exact":
+        return "highest"
+    if aggregate_precision == "fast":
+        return "default"
+    raise ValueError(f"aggregate_precision={aggregate_precision!r}: "
+                     f"must be 'exact' or 'fast'")
+
+
 def pad_binned_plans(plans: "list[BinnedPlans]", min_fwd=(0, 0),
                      min_bwd=(0, 0)) -> BinnedPlans:
     """Stack per-shard binned plans to common chunk counts (shard_map

@@ -66,13 +66,15 @@ class ShardedGraphData:
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
     mode: str = dataclasses.field(default="vertex",
                                   metadata={"static": True})
+    precision: str = dataclasses.field(default="exact",
+                                       metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
     ShardedGraphData,
     data_fields=["edge_src", "edge_dst", "in_degree", "send_idx",
                  "ring_src", "ring_dst", "plans"],
-    meta_fields=["backend", "mode"])
+    meta_fields=["backend", "mode", "precision"])
 
 
 def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
@@ -107,7 +109,8 @@ def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
 
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
-                backend: str = "xla") -> ShardedGraphData:
+                backend: str = "xla",
+                precision: str = "exact") -> ShardedGraphData:
     if halo is not None:
         src = halo.edge_src_local
     else:
@@ -125,6 +128,7 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         send_idx=None if halo is None else jnp.asarray(halo.send_idx),
         plans=plans,
         backend=backend,
+        precision=precision,
     )
 
 
@@ -248,8 +252,9 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
             if gd_block.backend == "binned":
                 return ops.scatter_gather_binned(table, gd_block.plans,
                                                  interp)
-            return ops.scatter_gather_matmul(table, gd_block.plans,
-                                             shard_nodes, table.shape[0])
+            return ops.scatter_gather_matmul(
+                table, gd_block.plans, shard_nodes, table.shape[0],
+                ops.matmul_precision(gd_block.precision))
         return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
                                   aggr)
 
@@ -335,7 +340,8 @@ class SpmdTrainer(BaseTrainer):
                 plans=None, backend=backend, mode="ring")
         self.halo = build_halo_maps(self.part) \
             if self._exchange_mode == "halo" else None
-        return shard_graph(self.part, self.halo, backend)
+        return shard_graph(self.part, self.halo, backend,
+                           cfg.aggregate_precision)
 
     def _build_graph_perhost(self, backend: str) -> ShardedGraphData:
         """Pod-scale path: this process reads only its parts' `.lux` byte
@@ -370,7 +376,8 @@ class SpmdTrainer(BaseTrainer):
             in_degree=jnp.asarray(local.in_degree, jnp.float32),
             send_idx=None if lhalo is None else jnp.asarray(lhalo.send_idx),
             plans=plans,
-            backend=backend)
+            backend=backend,
+            precision=cfg.aggregate_precision)
 
     def _place_parts(self, gd: ShardedGraphData,
                      spec: NamedSharding) -> ShardedGraphData:

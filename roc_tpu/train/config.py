@@ -43,6 +43,12 @@ class Config:
     heads: int = 8                # attention heads (gat only)
     aggr: str = ""                # "" = model default; sum|avg|max|min
     aggregate_backend: str = "auto"  # auto | xla | matmul | pallas(=binned) | binned
+    aggregate_precision: str = "exact"  # exact: fp32 one-hot dots (matches
+                                  # the reference's SGEMM); fast: single-pass
+                                  # bf16 MXU (features take one rounding —
+                                  # golden curves within +-1 sample,
+                                  # docs/GOLDEN.md; the binned backend is
+                                  # always 'fast' by construction)
     verbose: bool = False
     eval_every: int = 5           # reference evaluates every 5 epochs (gnn.cc:107)
     checkpoint_path: Optional[str] = None
@@ -93,6 +99,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-heads", type=int, default=8)
     p.add_argument("-aggr", default="",
                    choices=["", "sum", "avg", "max", "min"])
+    p.add_argument("-aggr-precision", dest="aggregate_precision",
+                   default="exact", choices=["exact", "fast"])
     p.add_argument("-aggr-backend", dest="aggregate_backend", default="auto",
                    choices=["auto", "xla", "matmul", "pallas", "binned"])
     p.add_argument("-v", dest="verbose", action="store_true")

@@ -40,12 +40,14 @@ class DenseGraphData:
     in_degree: jnp.ndarray  # [N] float32
     plans: object = None    # ops.AggregatePlans for plan-based backends
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
+    precision: str = dataclasses.field(default="exact",
+                                       metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
     DenseGraphData,
     data_fields=["edge_src", "edge_dst", "in_degree", "plans"],
-    meta_fields=["backend"])
+    meta_fields=["backend", "precision"])
 
 
 def pallas_interpret() -> bool:
@@ -91,7 +93,8 @@ def resolve_backend(backend: str, num_edges: int, num_rows: int = 0,
     return backend
 
 
-def dense_graph_data(graph, backend: str = "xla") -> DenseGraphData:
+def dense_graph_data(graph, backend: str = "xla",
+                     precision: str = "exact") -> DenseGraphData:
     backend = resolve_backend(backend, graph.num_edges, graph.num_nodes,
                               graph.num_nodes)
     plans = None
@@ -107,6 +110,7 @@ def dense_graph_data(graph, backend: str = "xla") -> DenseGraphData:
         in_degree=jnp.asarray(graph.in_degrees, jnp.float32),
         plans=plans,
         backend=backend,
+        precision=precision,
     )
 
 
@@ -117,8 +121,9 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
         if g.plans is not None and aggr == "sum":
             if g.backend == "binned":
                 return ops.scatter_gather_binned(x, g.plans, interp)
-            return ops.scatter_gather_matmul(x, g.plans, num_nodes,
-                                             x.shape[0])
+            return ops.scatter_gather_matmul(
+                x, g.plans, num_nodes, x.shape[0],
+                ops.matmul_precision(g.precision))
         return ops.scatter_gather(x, g.edge_src, g.edge_dst, num_nodes, aggr)
 
     def attend(h, a_src, a_dst, slope):
@@ -278,7 +283,8 @@ class Trainer(BaseTrainer):
     def _setup(self):
         ds, model = self.dataset, self.model
         backend = self._effective_backend()
-        self.gdata = dense_graph_data(ds.graph, backend)
+        self.gdata = dense_graph_data(ds.graph, backend,
+                                      self.config.aggregate_precision)
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.onehot_labels(), jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)

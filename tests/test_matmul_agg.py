@@ -151,3 +151,27 @@ def test_auto_backend_resolution(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert resolve_backend("auto", 100) == "xla"
     assert resolve_backend("auto", 1 << 21) == "matmul"
+
+
+def test_fast_precision_plumbs_through():
+    """-aggr-precision fast must reach the matmul backend and keep training
+    sane.  NOTE: on the CPU test backend DEFAULT and HIGHEST dot precision
+    are both full fp32, so this verifies plumbing, not the bf16 rounding —
+    hardware numerics are pinned by tests/test_tpu_hw.py."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("prec", 500, 5.0, 16, 4, n_train=100, n_val=100,
+                            n_test=100, seed=9)
+    layers = [16, 8, 4]
+    losses = {}
+    for prec in ("exact", "fast"):
+        cfg = Config(layers=layers, num_epochs=2, dropout_rate=0.0,
+                     eval_every=10**9, aggregate_backend="matmul",
+                     aggregate_precision=prec, seed=5)
+        tr = Trainer(cfg, ds, build_gcn(layers, 0.0))
+        assert tr.gdata.precision == prec
+        losses[prec] = [float(tr.run_epoch()) for _ in range(2)]
+    np.testing.assert_allclose(losses["fast"], losses["exact"], rtol=5e-3)

@@ -77,3 +77,21 @@ if __name__ == "__main__":   # direct hardware run, no pytest/conftest
     test_binned_vjp_on_hw()
     test_matmul_backend_on_hw()
     print("tpu hardware tests: all ok")
+
+
+def test_matmul_fast_precision_on_hw():
+    """fast precision (single-pass bf16 one-hot dots) must track the
+    fp32-exact path to bf16 tolerance on real hardware — the rounding the
+    CPU tests cannot exercise."""
+    from roc_tpu import ops
+    n, t, src, dst, x = next(_cases())
+    order = np.argsort(dst, kind="stable")
+    src, dst = src[order], dst[order]
+    plans = ops.build_aggregate_plans(src, dst, n, t)
+    exact = np.asarray(ops.scatter_gather_matmul(
+        jnp.asarray(x), plans, n, t, "highest"))
+    fast = np.asarray(ops.scatter_gather_matmul(
+        jnp.asarray(x), plans, n, t, "default"))
+    denom = np.maximum(np.abs(exact), 1.0)
+    assert float(np.max(np.abs(fast - exact) / denom)) < 2e-2
+    assert not np.allclose(fast, exact)   # bf16 rounding must be present
