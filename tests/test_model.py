@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from roc_tpu.graph import datasets
 from roc_tpu.models import build_gcn
@@ -94,3 +95,43 @@ def test_parse_args_reference_flags():
     assert (d.num_epochs, d.learning_rate, d.weight_decay, d.dropout_rate,
             d.decay_rate, d.decay_steps, d.seed) == (1, 0.01, 0.05, 0.5, 1.0,
                                                      100, 1)
+
+
+@pytest.mark.parametrize("backend", ["xla", "matmul", "binned"])
+def test_bf16_training_all_backends(backend):
+    """-bf16 (activation bf16, fp32 accumulation) must train on every
+    aggregation backend and reach sane accuracy."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("bf16", 500, 5.0, 16, 4, n_train=120,
+                            n_val=120, n_test=120, seed=2)
+    layers = [16, 16, 4]
+    cfg = Config(layers=layers, num_epochs=40, learning_rate=0.01,
+                 weight_decay=5e-4, dropout_rate=0.1, eval_every=10**9,
+                 aggregate_backend=backend, use_bf16=True, seed=3)
+    tr = Trainer(cfg, ds, build_gcn(layers, cfg.dropout_rate))
+    assert tr.x.dtype == jnp.bfloat16
+    for _ in range(cfg.num_epochs):
+        loss = tr.run_epoch()
+    assert np.isfinite(float(loss))
+    m = jax.device_get(tr.evaluate())
+    assert m.val_correct / m.val_all > 0.6, backend
+
+
+def test_bf16_sharded_smoke():
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+
+    ds = datasets.synthetic("bf16s", 260, 4.0, 8, 4, n_train=50, n_val=50,
+                            n_test=50, seed=4)
+    layers = [8, 8, 4]
+    cfg = Config(layers=layers, num_epochs=2, dropout_rate=0.0,
+                 eval_every=10**9, num_parts=4, use_bf16=True,
+                 edge_shard="off")
+    tr = SpmdTrainer(cfg, ds, build_gcn(layers, 0.0))
+    assert np.isfinite(float(tr.run_epoch()))
