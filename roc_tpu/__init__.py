@@ -16,10 +16,11 @@ Layer map (the TPU-native analog of SURVEY.md §1):
                   on TPU: HBM residency + sharding specs do their jobs)
   L2  graph/      CSR core, .lux IO, edge-balanced partitioner, datasets
   L3  ops/        pure-function ops with custom VJPs where sparsity needs it
-  L4  models/     op-graph builder + model zoo (GCN, SAGE, GIN, residual)
+  L4  models/     op-graph builder + model zoo (GCN, SAGE, GIN, GAT,
+                  residual deep GCN)
   L5  train/      config, driver epoch loop, metrics, checkpointing, CLI
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from roc_tpu.graph.csr import Csr  # noqa: F401
