@@ -340,6 +340,20 @@ class SpmdTrainer(BaseTrainer):
                 plans=None, backend=backend, mode="ring")
         self.halo = build_halo_maps(self.part) \
             if self._exchange_mode == "halo" else None
+        if backend == "matmul" and cfg.aggregate_backend == "auto":
+            # The global viability check (BaseTrainer's resolve) sees the
+            # whole-graph geometry; the per-shard plan only spans the halo
+            # table (S own rows + P*K received), which for locality-heavy
+            # partitions is far smaller than P*S — re-evaluate there before
+            # settling for matmul.  Gated on the same hardware flag.
+            from roc_tpu.ops.pallas.binned import binned_viable
+            from roc_tpu.train.driver import AUTO_BINNED
+            S_ = self.part.shard_nodes
+            table_rows = S_ + self.part.num_parts * self.halo.K \
+                if self.halo is not None else self.part.num_parts * S_
+            if AUTO_BINNED and binned_viable(
+                    S_, table_rows, int(self.part.num_edges_valid.max())):
+                backend = "binned"
         return shard_graph(self.part, self.halo, backend,
                            cfg.aggregate_precision)
 
@@ -519,7 +533,7 @@ class SpmdTrainer(BaseTrainer):
         exchange = self._exchange_mode
         optimizer = self.optimizer
         # pallas_call can't annotate vma yet; the matmul backend is plain XLA
-        check_vma = gd.plans is None or backend == "matmul"
+        check_vma = gd.plans is None or gd.backend == "matmul"
 
         def local_loss(params, x, labels, mask, gd_block, key):
             gctx = _shard_gctx(gd_block, S, exchange)

@@ -187,3 +187,68 @@ def test_pad_binned_plans_floors():
         out = np.asarray(ops.scatter_gather_binned(
             jnp.asarray(xs[i]), one, True))
         np.testing.assert_allclose(out, refs[i], rtol=1e-5, atol=1e-3)
+
+
+def test_auto_binned_selection(monkeypatch):
+    """With AUTO_BINNED on (the hardware flip), auto picks binned exactly
+    when the cell-occupancy criterion holds — dense-enough graphs yes,
+    huge sparse ones no."""
+    import roc_tpu.train.driver as drv
+    from roc_tpu.ops.pallas.binned import binned_viable
+
+    monkeypatch.setattr(drv, "AUTO_BINNED", True)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # Reddit-shape: viable (measured case)
+    assert binned_viable(232_965, 232_965, 23_526_267)
+    assert drv.resolve_backend("auto", 23_526_267, 232_965,
+                               232_965) == "binned"
+    # products-shape: not viable (measured ~5x padding)
+    assert not binned_viable(2_449_029, 2_449_029, 124_000_000)
+    assert drv.resolve_backend("auto", 124_000_000, 2_449_029,
+                               2_449_029) == "matmul"
+    # small graphs stay on xla regardless
+    assert drv.resolve_backend("auto", 1000, 500, 500) == "xla"
+
+
+def test_auto_binned_shard_level_refinement(monkeypatch):
+    """When the global viability check fails but the per-shard halo table
+    is dense (locality-heavy partitions, small K), the SPMD trainer must
+    upgrade auto->matmul to binned at shard geometry."""
+    import roc_tpu.train.driver as drv
+    from roc_tpu.graph.csr import add_self_edges, from_edges
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.ops.pallas.binned import binned_viable
+    from roc_tpu.train.config import Config
+
+    monkeypatch.setattr(drv, "AUTO_BINNED", True)
+    monkeypatch.setattr(drv, "AUTO_MATMUL_EDGES", 1 << 10)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # the backend spoof above must not push the kernels out of interpret
+    # mode on the CPU test platform
+    monkeypatch.setattr(drv, "pallas_interpret", lambda: True)
+
+    # 4 near-disjoint communities: global cells fail the bound, per-shard
+    # (own rows + tiny halo) cells pass it
+    n, P_ = 16384, 4
+    rng = np.random.default_rng(0)
+    q = n // P_
+    src = np.concatenate([rng.integers(i * q, (i + 1) * q, 15000)
+                          for i in range(P_)])
+    dst = np.concatenate([rng.integers(i * q, (i + 1) * q, 15000)
+                          for i in range(P_)])
+    keep = src != dst
+    g = add_self_edges(from_edges(n, src[keep], dst[keep]))
+    assert not binned_viable(n, n, g.num_edges)          # global: no
+    ds = datasets.Dataset(
+        name="comm", graph=g,
+        features=rng.normal(size=(n, 8)).astype(np.float32),
+        labels=None, label_ids=np.zeros(n, np.int64),
+        mask=np.zeros(n, np.int32), in_dim=8, num_classes=4)
+    cfg = Config(layers=[8, 8, 4], num_epochs=1, dropout_rate=0.0,
+                 eval_every=10 ** 9, num_parts=P_, halo=True,
+                 edge_shard="off")
+    tr = SpmdTrainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    assert tr.gdata.backend == "binned", tr.gdata.backend
+    assert np.isfinite(float(tr.run_epoch()))
