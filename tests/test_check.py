@@ -54,3 +54,18 @@ def test_predict_classes_sharded_equals_single(ds):
     p1, pp = predict_classes(t1), predict_classes(tp)
     assert p1.shape == pp.shape == (ds.graph.num_nodes,)
     np.testing.assert_array_equal(p1, pp)
+
+
+def test_check_sharding_ring_mode():
+    """-check-sharding must pass for the ring exchange trainer too (the
+    checker compares against a fresh single-device run)."""
+    ds = datasets.synthetic("ckr", 240, 4.0, 8, 4, n_train=50, n_val=50,
+                            n_test=50, seed=11)
+    cfg = Config(layers=[8, 8, 4], num_epochs=1, dropout_rate=0.0,
+                 eval_every=10**9, num_parts=4, exchange="ring",
+                 edge_shard="off")
+    tr = SpmdTrainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    # raises on mismatch; returns the two PerfMetrics for inspection
+    m1, mp = check_shard_consistency(
+        cfg, ds, build_gcn(cfg.layers, 0.0), sharded_trainer=tr)
+    assert int(np.asarray(m1.train_all)) == int(np.asarray(mp.train_all))
