@@ -294,6 +294,42 @@ def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
 # Phase-1 kernel: one-hot expand + slot-scatter to staging.
 # ---------------------------------------------------------------------------
 
+def _p1_kernel_simple(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf,
+                      offbuf, sems):
+    """Single-buffered fallback (ROC_BINNED_NO_PIPELINE=1): issue all slot
+    DMAs then drain them in the same chunk.  No cross-chunk overlap, but
+    structurally identical to the skeleton measured on hardware — keep as
+    the bisection baseline if the pipelined kernel misbehaves on a new
+    Mosaic version."""
+    c = pl.program_id(0)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+    t = (lane == srcl_ref[:]).astype(jnp.bfloat16)
+    gbuf[0] = jax.lax.dot_general(
+        t, x_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+    def issue(s, _):
+        @pl.when(off_ref[c % 8, s] >= 0)
+        def _():
+            pltpu.make_async_copy(
+                gbuf.at[0].at[pl.ds(s * SLOT, SLOT)],
+                stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)],
+                sems.at[0]).start()
+        return 0
+    jax.lax.fori_loop(0, NSLOT, issue, 0)
+
+    def drain(s, _):
+        @pl.when(off_ref[c % 8, s] >= 0)
+        def _():
+            pltpu.make_async_copy(
+                gbuf.at[0].at[pl.ds(s * SLOT, SLOT)],
+                stg_ref.at[pl.ds(off_ref[c % 8, s] * SLOT, SLOT)],
+                sems.at[0]).wait()
+        return 0
+    jax.lax.fori_loop(0, NSLOT, drain, 0)
+
+
 def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
                sems):
     """Double-buffered: the slot DMAs issued for chunk c drain at chunk
@@ -352,6 +388,9 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
 @partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret"))
 def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
             interpret: bool = False):
+    import os
+    kernel = _p1_kernel_simple \
+        if os.environ.get("ROC_BINNED_NO_PIPELINE") else _p1_kernel
     H = x.shape[-1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,                  # blk [C1]
@@ -368,7 +407,7 @@ def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
                         pltpu.SemaphoreType.DMA((2,))],
     )
     return pl.pallas_call(
-        _p1_kernel, grid_spec=grid_spec,
+        kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((stg_rows, H), jnp.bfloat16),
         interpret=interpret,
     )(blk, off, srcl, x)
