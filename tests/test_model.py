@@ -135,3 +135,23 @@ def test_bf16_sharded_smoke():
                  edge_shard="off")
     tr = SpmdTrainer(cfg, ds, build_gcn(layers, 0.0))
     assert np.isfinite(float(tr.run_epoch()))
+
+
+def test_cli_round2_flags_parse():
+    """Round-2 CLI flags parse to the expected Config fields."""
+    from roc_tpu.train.config import parse_args
+
+    cfg = parse_args(["-file", "x", "-layers", "8-4",
+                      "-aggr-backend", "binned", "-aggr-precision", "fast",
+                      "-exchange", "ring", "-edge-shard", "off"])
+    assert cfg.aggregate_backend == "binned"
+    assert cfg.aggregate_precision == "fast"
+    assert cfg.exchange == "ring" and cfg.exchange_mode() == "ring"
+    assert cfg.edge_shard == "off"
+    # bare -edge-shard means "on"; default is auto; -no-halo maps exchange
+    cfg2 = parse_args(["-file", "x", "-layers", "8-4", "-edge-shard"])
+    assert cfg2.edge_shard == "on"
+    cfg3 = parse_args(["-file", "x", "-layers", "8-4"])
+    assert cfg3.edge_shard == "auto" and cfg3.exchange_mode() == "halo"
+    cfg4 = parse_args(["-file", "x", "-layers", "8-4", "-no-halo"])
+    assert cfg4.exchange_mode() == "allgather"
