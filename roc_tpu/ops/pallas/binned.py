@@ -459,10 +459,17 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False):
 
     x: [table_rows, H] (any float dtype) -> [num_rows, H] in x.dtype.
     fp32 accumulation; features take one bf16 rounding (see module doc)."""
+    # Mosaic requires DMA slices lane-aligned to the (8,128) tile: the slot
+    # DMAs out of gbuf slice the H axis, so H must be a multiple of 128
+    # (observed hard error at H=41: "Slice shape along dimension 2 must be
+    # aligned to tiling (128)").  Pad features up and strip at the end —
+    # the extra lanes ride the same tiles the hardware moves anyway.
     H = x.shape[-1]
+    Hp = _pad_to(H, 128)
     G, C1 = plan.p1_blk.shape
     C2 = plan.p2_obi.shape[1]
-    xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, SB) - x.shape[0]), (0, 0)))
+    xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, SB) - x.shape[0]),
+                     (0, Hp - H)))
     stg_rows = C2 * CH2
 
     def body(_, gplan):
@@ -476,8 +483,8 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False):
         body, None,
         (plan.p1_srcl, plan.p1_off, plan.p1_blk,
          plan.p2_dstl, plan.p2_obi, plan.p2_first))
-    out = outs.reshape(G * plan.bins_per_group * RB, H)
-    return out[:plan.num_rows].astype(x.dtype)
+    out = outs.reshape(G * plan.bins_per_group * RB, Hp)
+    return out[:plan.num_rows, :H].astype(x.dtype)
 
 
 def pad_binned_plan(plan: BinnedPlan, C1: int, C2: int) -> BinnedPlan:

@@ -69,9 +69,10 @@ def device_sync(x):
 # index (measured ~6.5 s/aggregation at Reddit scale on v5e; see
 # roc_tpu/ops/aggregate.py).  CPU/GPU scatters are fine as-is.
 AUTO_MATMUL_EDGES = 1 << 20
-# Flip to True once the binned kernels are measured faster on hardware
-# (pending BENCH_r02; the CPU-side evidence is in docs/PERF.md+GOLDEN.md).
-AUTO_BINNED = False
+# Measured on v5e (2026-07-31, Reddit-shape bench): binned 0.752 s/epoch vs
+# matmul-fast 0.821 s vs xla 2.39 s — binned wins where its padding model
+# holds (binned_viable); elsewhere matmul remains the fast path.  PERF.md.
+AUTO_BINNED = True
 
 
 def resolve_backend(backend: str, num_edges: int, num_rows: int = 0,

@@ -32,6 +32,8 @@ CASES = [
     (513, 513, 1, 8),           # single edge, just past one bin
     (SB + 1, SB + 1, 300, 16),  # two source blocks
     (3 * RB, 1000, 3000, 16),   # partial last bin group (G=2, bpg=2)
+    (700, 700, 5000, 41),       # lane-unaligned H (GCN output layer):
+                                # run_binned pads H to 128 internally
 ]
 
 

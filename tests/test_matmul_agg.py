@@ -151,6 +151,9 @@ def test_auto_backend_resolution(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert resolve_backend("auto", 100) == "xla"
     assert resolve_backend("auto", 1 << 21) == "matmul"
+    # AUTO_BINNED default is True (hardware-measured win, PERF.md): with
+    # geometry given and viable, auto resolves to binned
+    assert resolve_backend("auto", 23_526_267, 232_965, 232_965) == "binned"
 
 
 def test_fast_precision_plumbs_through():

@@ -27,8 +27,12 @@ except ImportError:                     # direct `python tests/test_tpu_hw.py`
 
 def _cases():
     rng = np.random.default_rng(0)
+    # h=41 pins the lane-unaligned path (the GCN output layer): Mosaic
+    # rejects DMA slices not aligned to the 128-lane tile, so run_binned
+    # must pad H internally — only a hardware run can see that failure.
     for (n, t, e, h) in [(2000, 2000, 60000, 128),
-                         (3000, 4000, 100000, 256)]:
+                        (3000, 4000, 100000, 256),
+                        (2000, 2000, 60000, 41)]:
         src = rng.integers(0, t, e).astype(np.int64)
         dst = rng.integers(0, n, e).astype(np.int64)
         dst[: e // 5] = 11                      # hub destination
@@ -70,15 +74,6 @@ def test_matmul_backend_on_hw():
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-3)
 
 
-if __name__ == "__main__":   # direct hardware run, no pytest/conftest
-    if not tpu:
-        raise SystemExit("no TPU backend")
-    test_binned_compiles_and_matches_on_hw()
-    test_binned_vjp_on_hw()
-    test_matmul_backend_on_hw()
-    print("tpu hardware tests: all ok")
-
-
 def test_matmul_fast_precision_on_hw():
     """fast precision (single-pass bf16 one-hot dots) must track the
     fp32-exact path to bf16 tolerance on real hardware — the rounding the
@@ -95,3 +90,13 @@ def test_matmul_fast_precision_on_hw():
     denom = np.maximum(np.abs(exact), 1.0)
     assert float(np.max(np.abs(fast - exact) / denom)) < 2e-2
     assert not np.allclose(fast, exact)   # bf16 rounding must be present
+
+
+if __name__ == "__main__":   # direct hardware run, no pytest/conftest
+    if not tpu:
+        raise SystemExit("no TPU backend")
+    test_binned_compiles_and_matches_on_hw()
+    test_binned_vjp_on_hw()
+    test_matmul_backend_on_hw()
+    test_matmul_fast_precision_on_hw()
+    print("tpu hardware tests: all ok")
