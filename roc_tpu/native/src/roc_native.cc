@@ -357,10 +357,10 @@ int roc_halo_fill(const int64_t* edge_src, int64_t P, int64_t E, int64_t S,
 // Python can assert agreement before trusting a native plan.
 // ---------------------------------------------------------------------------
 
-static const int64_t BN_SB = 512, BN_CH = 2048, BN_SLOT = 32;
+static const int64_t BN_SB = 512, BN_CH = 2048, BN_SLOT = 128;
 static const int64_t BN_RB = 512, BN_CH2 = 4096;
-static const int64_t BN_NSLOT = BN_CH / BN_SLOT;     // 64
-static const int64_t BN_SLOT2 = BN_CH2 / BN_SLOT;    // 128
+static const int64_t BN_NSLOT = BN_CH / BN_SLOT;     // 16
+static const int64_t BN_SLOT2 = BN_CH2 / BN_SLOT;    // 32
 static const int64_t BN_K2_CAP = (int64_t)1 << 25;   // binned.py _K2_CAP
 
 void roc_binned_geometry(int64_t* out5) {

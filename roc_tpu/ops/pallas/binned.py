@@ -58,7 +58,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 SB = 512      # source rows per x block (phase-1 streaming unit)
 CH = 2048     # edge slots per phase-1 chunk
-SLOT = 32     # staging write granularity (rows; multiple of bf16 sublane 16)
+# Staging write granularity (rows; multiple of the bf16 sublane 16).  Swept
+# on v5e at Reddit scale (docs/PERF.md): 32 -> 203.7 ms, 64 -> 189.2,
+# 128 -> 184.4 per aggregation — phase 1 is partly DMA-issue-bound, and
+# 4x fewer slot DMAs beats the slightly higher cell padding.
+SLOT = 128
 RB = 512      # destination rows per bin (phase-2 resident window)
 CH2 = 4096    # staging rows per phase-2 chunk
 NSLOT = CH // SLOT
@@ -112,15 +116,17 @@ def binned_viable(num_rows: int, table_rows: int, num_edges: int) -> bool:
 
     Cells are (source-block x bin) pairs and every non-empty cell pads to
     SLOT rows; with ~uniform edges the number of touched cells approaches
-    min(E, blocks * bins), so the schedule stays tight only while the
-    average cell holds several SLOTs worth of edges.  Below that (huge
-    sparse graphs: ogbn-products-scale N with modest degree) the padding
-    factor blows up -- measured ~5x at products scale -- and the one-hot
-    matmul backend is the right fast path instead.  The 3*SLOT bound keeps
-    expected padding under ~15%."""
+    min(E, blocks * bins), so the expected slot-padding factor is about
+    blocks*bins*SLOT / E (each touched cell pays at least one SLOT).  The
+    bound accepts up to ~25% slot-padding tax; beyond that (huge sparse
+    graphs: ogbn-products-scale N with modest degree, measured ~5x padding)
+    the one-hot matmul backend is the right fast path instead.  Threshold:
+    average cell >= SLOT*4/5 = 102.4 edges — slightly tighter than the
+    round-2 3*SLOT(=32) rule's >= 96; graphs averaging 96-102 edges/cell
+    now take the matmul backend instead."""
     num_bins = max(-(-num_rows // RB), 1)
     num_blocks = max(-(-table_rows // SB), 1)
-    return num_blocks * num_bins * 3 * SLOT <= num_edges
+    return num_blocks * num_bins * SLOT * 4 <= num_edges * 5
 
 
 def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
@@ -458,7 +464,11 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False):
     """out[v] = sum over in-edges of x[src] via the two-phase schedule.
 
     x: [table_rows, H] (any float dtype) -> [num_rows, H] in x.dtype.
-    fp32 accumulation; features take one bf16 rounding (see module doc)."""
+    fp32 accumulation; features take one bf16 rounding (see module doc).
+
+    Call under jit (the trainer always does): measured on v5e at Reddit
+    scale, the eager path pays ~6x in scan dispatch overhead (1.65 s vs
+    213 ms jitted — docs/PERF.md)."""
     # Mosaic requires DMA slices lane-aligned to the (8,128) tile: the slot
     # DMAs out of gbuf slice the H axis, so H must be a multiple of 128
     # (observed hard error at H=41: "Slice shape along dimension 2 must be
