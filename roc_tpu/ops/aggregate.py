@@ -102,6 +102,15 @@ def scatter_gather(x, edge_src, edge_dst, num_nodes: int, aggr: str = "sum"):
     raise ValueError(f"unknown aggr {aggr!r}")
 
 
+def divide_by_degree(out, in_degree):
+    """avg from a sum aggregation: out / max(in_degree, 1), matching the
+    xla oracle's count guard.  The single semantics shared by every avg
+    call site (single-device plan path, sharded plan path, ring,
+    edge-shard): in_degree is the live in-edge count per output row (pad
+    rows carry 1 and their sums are zero, so they stay zero)."""
+    return out / jnp.maximum(in_degree, 1.0).astype(out.dtype)[:, None]
+
+
 # ---------------------------------------------------------------------------
 # Chunk plans shared by the one-hot (matmul) backend.
 # ---------------------------------------------------------------------------
@@ -178,7 +187,8 @@ def pad_plans(plans: "list[AggregatePlans]", min_fwd: int = 0,
 
 
 # ---------------------------------------------------------------------------
-# Matmul backend (sum only): scatter-free aggregation in pure XLA.
+# Matmul backend (sum; avg = sum/in-degree at the call sites):
+# scatter-free aggregation in pure XLA.
 # ---------------------------------------------------------------------------
 #
 # TPU scatter is serialized per index (measured ~6.5 s for one Reddit-scale
@@ -281,7 +291,8 @@ scatter_gather_matmul.defvjp(_mm_fwd, _mm_bwd)
 
 
 # ---------------------------------------------------------------------------
-# Binned backend (sum only): two-phase Pallas kernels, gather-free.
+# Binned backend (sum; avg = sum/in-degree at the call sites):
+# two-phase Pallas kernels, gather-free.
 # ---------------------------------------------------------------------------
 
 class BinnedPlans(NamedTuple):

@@ -190,7 +190,7 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
                     [base], x.dtype) + 0 * x[:1, :1]
     (_, acc), _ = jax.lax.scan(step, (x, init), jnp.arange(P_))
     if aggr == "avg":
-        acc = acc / jnp.maximum(gd_block.in_degree, 1.0)[:, None]
+        acc = ops.divide_by_degree(acc, gd_block.in_degree)
     if base in ("max", "min"):
         # rows with no in-edges anywhere stayed at the segment identity:
         # zero exactly those (convention shared with ops.scatter_gather;
@@ -224,7 +224,7 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
             out = jax.lax.psum_scatter(partial, PARTS_AXIS,
                                        scatter_dimension=0, tiled=True)
             if aggr == "avg":   # all in-edges of a vertex => count = degree
-                out = out / jnp.maximum(gd_block.in_degree, 1.0)[:, None]
+                out = ops.divide_by_degree(out, gd_block.in_degree)
             return out
 
         def attend_edge(h, a_src, a_dst, slope):
@@ -248,13 +248,19 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
 
     def aggregate(x, aggr):
         table = _exchange(gd_block, exchange, x)
-        if gd_block.plans is not None and aggr == "sum":
+        # avg rides the sum fast path: per-shard in_degree is the live
+        # in-edge count (pad rows carry 1, and their sums are zero anyway).
+        if gd_block.plans is not None and aggr in ("sum", "avg"):
             if gd_block.backend == "binned":
-                return ops.scatter_gather_binned(table, gd_block.plans,
-                                                 interp)
-            return ops.scatter_gather_matmul(
-                table, gd_block.plans, shard_nodes, table.shape[0],
-                ops.matmul_precision(gd_block.precision))
+                out = ops.scatter_gather_binned(table, gd_block.plans,
+                                                interp)
+            else:
+                out = ops.scatter_gather_matmul(
+                    table, gd_block.plans, shard_nodes, table.shape[0],
+                    ops.matmul_precision(gd_block.precision))
+            if aggr == "avg":
+                out = ops.divide_by_degree(out, gd_block.in_degree)
+            return out
         return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
                                   aggr)
 

@@ -119,12 +119,18 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
     interp = pallas_interpret()
 
     def aggregate(x, aggr):
-        if g.plans is not None and aggr == "sum":
+        # avg rides the sum fast path: avg = sum / in-degree (in_degree is
+        # the live in-edge count — GraphSAGE-mean gets the plan backends).
+        if g.plans is not None and aggr in ("sum", "avg"):
             if g.backend == "binned":
-                return ops.scatter_gather_binned(x, g.plans, interp)
-            return ops.scatter_gather_matmul(
-                x, g.plans, num_nodes, x.shape[0],
-                ops.matmul_precision(g.precision))
+                out = ops.scatter_gather_binned(x, g.plans, interp)
+            else:
+                out = ops.scatter_gather_matmul(
+                    x, g.plans, num_nodes, x.shape[0],
+                    ops.matmul_precision(g.precision))
+            if aggr == "avg":
+                out = ops.divide_by_degree(out, g.in_degree)
+            return out
         return ops.scatter_gather(x, g.edge_src, g.edge_dst, num_nodes, aggr)
 
     def attend(h, a_src, a_dst, slope):
@@ -162,9 +168,9 @@ class BaseTrainer:
         raise NotImplementedError
 
     def _effective_backend(self) -> str:
-        """The plan-based backends (binned/matmul) only implement sum
-        aggregation; don't pay plan construction when the built model
-        contains no sum-aggregate op."""
+        """The plan-based backends (binned/matmul) implement sum and avg
+        (avg = plan-sum / in-degree); don't pay plan construction when the
+        built model contains neither."""
         cfg = self.config
         if self._use_edge_shard:
             # edge-sharded aggregation is its own data path (psum_scatter of
@@ -177,11 +183,11 @@ class BaseTrainer:
         backend = resolve_backend(cfg.aggregate_backend, g.num_edges,
                                   g.num_nodes, g.num_nodes)
         aggrs = self._model_aggrs()
-        if backend in ("binned", "matmul") and "sum" not in aggrs:
+        if backend in ("binned", "matmul") and not ({"sum", "avg"} & aggrs):
             if cfg.aggregate_backend != "auto":   # user explicitly chose it
-                print(f"# aggregate_backend={backend} only accelerates sum "
-                      f"aggregation; this model uses {sorted(aggrs)} — "
-                      f"using xla")
+                print(f"# aggregate_backend={backend} only accelerates "
+                      f"sum/avg aggregation; this model uses "
+                      f"{sorted(aggrs)} — using xla")
             return "xla"
         return backend
 
