@@ -37,6 +37,20 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     assert tr2.epoch == 8
 
 
+def test_checkpoint_extra_roundtrip(tmp_path):
+    """The free-form `extra` dict (host-side trainer state beyond
+    params/opt/epoch/alpha) must survive save -> load intact."""
+    from roc_tpu.train import checkpoint
+
+    tr, cfg = make_trainer(tmp_path)
+    extra = {"best_val": 0.875, "note": "after sweep", "ids": [1, 2, 3]}
+    tr.save_checkpoint(cfg.checkpoint_path, extra=extra)
+    _, _, epoch, alpha, got = checkpoint.load(
+        cfg.checkpoint_path, tr.params, tr.opt_state)
+    assert got == extra
+    assert epoch == tr.epoch and alpha == tr.optimizer.alpha
+
+
 def test_checkpoint_atomic_overwrite(tmp_path):
     tr, cfg = make_trainer(tmp_path)
     tr.save_checkpoint(cfg.checkpoint_path)

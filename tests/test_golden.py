@@ -59,3 +59,32 @@ def test_golden_cora_curve_binned_backend():
     m = jax.device_get(tr.evaluate())
     assert m.val_correct / m.val_all >= 0.965
     assert float(m.train_loss) <= 1.5
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,pins", [
+    # (epoch, min val accuracy); final (epoch, max loss) — docs/GOLDEN.md
+    ("sage", {5: 0.96, 20: 0.975, "loss20": 0.1}),
+    ("gin", {20: 0.78, "loss20": 33.0}),
+    ("gat", {20: 0.955, "loss20": 0.5}),
+])
+def test_golden_zoo_curves(name, pins):
+    """Fixed-seed accuracy pins for the model zoo (docs/GOLDEN.md) — the
+    zoo's version of the reference's accuracy oracle.  Conservative
+    thresholds leave cross-platform float headroom."""
+    from roc_tpu.models import build_model
+
+    ds = datasets.get("cora", seed=1)
+    cfg = Config(layers=[1433, 16, 7], num_epochs=20, learning_rate=0.01,
+                 weight_decay=5e-4, dropout_rate=0.5, seed=1,
+                 eval_every=10**9)
+    tr = Trainer(cfg, ds, build_model(name, cfg.layers, cfg.dropout_rate))
+    for epoch in range(20):
+        if epoch in pins:
+            m = jax.device_get(tr.evaluate())
+            assert m.val_correct / m.val_all >= pins[epoch], (name, epoch)
+        tr.run_epoch()
+    m = jax.device_get(tr.evaluate())
+    if 20 in pins:
+        assert m.val_correct / m.val_all >= pins[20], name
+    assert float(m.train_loss) <= pins["loss20"], name
