@@ -36,6 +36,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-epoch end-to-end runs (golden curves)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
