@@ -36,6 +36,17 @@ def edge_softmax(scores, edge_dst, num_nodes: int):
     return e / jnp.maximum(jnp.take(s, edge_dst, axis=0), 1e-38)
 
 
+# GAT switches to the edge-chunked scan above the same gathered-intermediate
+# budget as aggregate._chunked_segment_sum (2^28 elems = 1 GiB fp32 — at
+# Reddit scale the dense [E, K, F] alone is ~24 GB, over a v5e's HBM).
+# Shared constants so the two memory policies cannot drift.
+from roc_tpu.ops.aggregate import (          # noqa: E402
+    _CHUNK_TARGET_ELEMS as _GAT_CHUNK_TARGET_ELEMS,
+    _CHUNK_THRESHOLD_ELEMS as _GAT_CHUNK_THRESHOLD_ELEMS)
+
+_GAT_CHUNK_MIN = 1024     # floor on edge-chunk length (tests shrink it)
+
+
 def gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
                a_src, a_dst, slope: float):
     """Multi-head graph attention aggregation (GAT).
@@ -48,6 +59,10 @@ def gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
     alpha = edge_softmax(s); out[v] = sum_e alpha_e * table[src_e].
     Returns [N_local, K, F].
     """
+    E, (K, F) = edge_src.shape[0], h.shape[1:]
+    if E * K * F > _GAT_CHUNK_THRESHOLD_ELEMS:
+        return _chunked_gat_attend(h, table, edge_src, edge_dst, num_nodes,
+                                   a_src, a_dst, slope)
     as_t = jnp.einsum("tkf,kf->tk", table, a_src)     # [T, K]
     ad_l = jnp.einsum("nkf,kf->nk", h, a_dst)         # [N_local, K]
     s = jax.nn.leaky_relu(
@@ -58,3 +73,68 @@ def gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
     return jax.ops.segment_sum(g * alpha[:, :, None], edge_dst,
                                num_segments=num_nodes,
                                indices_are_sorted=True)
+
+
+def _chunked_gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
+                        a_src, a_dst, slope: float):
+    """Memory-bounded GAT: never materializes [E, K, F].
+
+    Standard streaming softmax shape: (1) one edge-chunk scan accumulates
+    the per-destination score max m; (2) a second scan accumulates both the
+    normalizer z[v] = Σ exp(s_e - m[v]) and the unnormalized output
+    Σ exp(s_e - m[v])·table[src_e]; out = unnorm / z.  Same math as the
+    dense path (softmax shift by the exact per-dst max), different sum
+    order — equal up to float reassociation.  Working set per step:
+    [chunk, K, F] plus the [N, K(, F)] accumulators.  Pad edges (routed to
+    pad dst rows) only pollute pad rows.
+
+    The bound must survive autodiff, where lax.scan stacks per-step
+    residuals back up to O(E*K*F): the accumulate body is rematerialized
+    (jax.checkpoint — backward recomputes each chunk's gather/exp instead
+    of saving them) and the max scan carries no gradient at all
+    (stop_gradient on m: softmax is shift-invariant, d out/d m == 0).
+    """
+    E, (K, F) = edge_src.shape[0], h.shape[1:]
+    as_t = jnp.einsum("tkf,kf->tk", table, a_src)     # [T, K]
+    ad_l = jnp.einsum("nkf,kf->nk", h, a_dst)         # [N_local, K]
+
+    chunk = max(_GAT_CHUNK_TARGET_ELEMS // max(K * F, 1), _GAT_CHUNK_MIN)
+    nchunks = -(-E // chunk)
+    pad = nchunks * chunk - E
+    # pad edges: src 0 (harmless), dst at the extra throwaway row
+    src = jnp.pad(edge_src, (0, pad)).reshape(nchunks, chunk)
+    dst = jnp.pad(edge_dst, (0, pad),
+                  constant_values=num_nodes).reshape(nchunks, chunk)
+
+    def scores(s_ids, d_ids):
+        return jax.nn.leaky_relu(
+            jnp.take(ad_l, jnp.minimum(d_ids, num_nodes - 1), axis=0)
+            + jnp.take(as_t, s_ids, axis=0), negative_slope=slope)
+
+    def max_body(m, sl):
+        s_ids, d_ids = sl
+        return m.at[d_ids].max(scores(s_ids, d_ids),
+                               indices_are_sorted=True,
+                               mode="promise_in_bounds"), None
+    m0 = jnp.full((num_nodes + 1, K), -jnp.inf, as_t.dtype)
+    m, _ = jax.lax.scan(max_body, m0, (src, dst))
+    m = jnp.where(jnp.isfinite(m), m, 0.0)            # edgeless destinations
+    m = jax.lax.stop_gradient(m)
+
+    def acc_body(carry, sl):
+        z, out = carry
+        s_ids, d_ids = sl
+        e = jnp.exp(scores(s_ids, d_ids)
+                    - jnp.take(m, d_ids, axis=0))     # [chunk, K]
+        z = z.at[d_ids].add(e, indices_are_sorted=True,
+                            mode="promise_in_bounds")
+        g = jnp.take(table, s_ids, axis=0)            # [chunk, K, F]
+        out = out.at[d_ids].add(g * e[:, :, None], indices_are_sorted=True,
+                                mode="promise_in_bounds")
+        return (z, out), None
+    z0 = jnp.zeros((num_nodes + 1, K), as_t.dtype)
+    o0 = jnp.zeros((num_nodes + 1, K, F), h.dtype)
+    (z, out), _ = jax.lax.scan(
+        jax.checkpoint(acc_body, prevent_cse=False), (z0, o0), (src, dst))
+    return (out[:num_nodes]
+            / jnp.maximum(z[:num_nodes], 1e-38)[:, :, None])
