@@ -92,6 +92,20 @@ def test_matmul_fast_precision_on_hw():
     assert not np.allclose(fast, exact)   # bf16 rounding must be present
 
 
+def test_binned_avg_on_hw():
+    """avg rides the binned sum backend divided by in-degree; check the
+    full composition against the NumPy mean on the chip."""
+    from roc_tpu import ops
+    n, t, src, dst, x = next(_cases())
+    plans = ops.build_binned_plans(src, dst, n, t)
+    s = ops.scatter_gather_binned(jnp.asarray(x), plans, False)
+    deg = np.zeros(n, np.float32)
+    np.add.at(deg, dst, 1.0)
+    out = np.asarray(ops.divide_by_degree(s, jnp.asarray(deg)))
+    ref = _oracle_bf16(x, src, dst, n) / np.maximum(deg, 1.0)[:, None]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=5e-2)
+
+
 if __name__ == "__main__":   # direct hardware run, no pytest/conftest
     if not tpu:
         raise SystemExit("no TPU backend")
@@ -99,4 +113,5 @@ if __name__ == "__main__":   # direct hardware run, no pytest/conftest
     test_binned_vjp_on_hw()
     test_matmul_backend_on_hw()
     test_matmul_fast_precision_on_hw()
+    test_binned_avg_on_hw()
     print("tpu hardware tests: all ok")
