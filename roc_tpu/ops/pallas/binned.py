@@ -48,6 +48,7 @@ cannot leak through a 0 coefficient.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 
 import jax
@@ -68,8 +69,11 @@ CH2 = 4096    # staging rows per phase-2 chunk
 NSLOT = CH // SLOT
 SLOT2 = CH2 // SLOT   # slots per phase-2 chunk
 
-# Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).
-_GROUP_ROW_TARGET = 1 << 21
+# Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).  Fewer
+# groups = less per-(group, block) chunk-rounding padding in phase 1 at the
+# cost of a proportionally larger staging buffer; ROC_BINNED_GROUP_ROWS
+# overrides for hardware sweeps (tools/sweep_binned.py).
+_GROUP_ROW_TARGET = int(os.environ.get("ROC_BINNED_GROUP_ROWS", 1 << 21))
 # Cap on the dense (source-block x bin) cell table per group — bounds the
 # plan builders' memory on huge sparse graphs to ~256 MiB of int64 cells
 # (the native builder allocates it densely; mirrored there as BN_K2_CAP).
@@ -394,7 +398,6 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
 @partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret"))
 def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
             interpret: bool = False):
-    import os
     kernel = _p1_kernel_simple \
         if os.environ.get("ROC_BINNED_NO_PIPELINE") else _p1_kernel
     H = x.shape[-1]
