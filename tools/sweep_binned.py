@@ -1,92 +1,109 @@
 """Sweep binned-kernel constants on the real chip (uniform Reddit-scale).
 
-Monkeypatches module globals (SB/CH/SLOT/RB/CH2 + derived) before plan
-build and run; uses the NumPy plan builder (the native one bakes the
-constants in).  Results of record: docs/PERF.md (2026-07-31 sweep that
-picked SLOT=128).  Run on hardware:  python tools/sweep_binned.py
+Each config runs in its own SUBPROCESS with a timeout: a wedged remote
+compile (observed — it can hang the axon tunnel indefinitely) then costs
+one config, not the whole sweep.  Inside the child, module globals
+(SB/CH/SLOT/RB/CH2 + derived) are monkeypatched before plan build and run;
+the NumPy plan builder is used (the native one bakes the constants in).
+
+Results of record: docs/PERF.md (2026-07-31 sweep that picked SLOT=128).
+Run on hardware:  python tools/sweep_binned.py
+One config (child mode): python tools/sweep_binned.py SB CH SLOT RB CH2 GRT
 
 Edit CONFIGS below; each row is (SB, CH, SLOT, RB, CH2, group_row_target).
 After changing shipped defaults, mirror them in roc_tpu/ops/pallas/binned.py
 AND the BN_* constants in roc_tpu/native/src/roc_native.cc.
 """
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
-
-import roc_tpu.ops.pallas.binned as B
-
-H = 256
-E = 23_526_267
-N = 232_965
-
-rng = np.random.default_rng(0)
-src = rng.integers(0, N, E).astype(np.int64)
-dst = rng.integers(0, N, E).astype(np.int64)
-x = jnp.asarray(rng.standard_normal((N, H), dtype=np.float32))
-
-ref = None
+H = int(os.environ.get("SWEEP_H", 256))
+E = int(os.environ.get("SWEEP_E", 23_526_267))
+N = int(os.environ.get("SWEEP_N", 232_965))
+CHILD_TIMEOUT_S = int(os.environ.get("SWEEP_TIMEOUT_S", 600))
 
 # (SB, CH, SLOT, RB, CH2, group_row_target)
 CONFIGS = [
-    (512, 2048, 128, 512, 4096, 1 << 21),   # round-1 best
+    (512, 2048, 128, 512, 4096, 1 << 21),   # shipped defaults
     (512, 2048, 128, 512, 4096, 1 << 22),   # fewer groups, less rounding
     (512, 2048, 128, 512, 4096, 1 << 23),
-    (512, 1024, 128, 512, 4096, 1 << 21),   # smaller chunks, less rounding
-    (512, 1024, 128, 512, 4096, 1 << 22),
+    (512, 1024, 128, 512, 4096, 1 << 22),   # smaller chunks, less rounding
     (512, 1024, 64, 512, 4096, 1 << 22),
     (512, 2048, 128, 256, 4096, 1 << 22),   # smaller bins (less VPU)
     (256, 2048, 128, 512, 4096, 1 << 22),   # smaller source blocks
 ]
 
 
-def set_consts(sb, ch, slot, rb, ch2):
+def run_one(sb, ch, slot, rb, ch2, grt):
+    """Child-process body: measure one config, print one line."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import roc_tpu.ops.pallas.binned as B
+
     B.SB, B.CH, B.SLOT, B.RB, B.CH2 = sb, ch, slot, rb, ch2
     B.NSLOT = ch // slot
     B.SLOT2 = ch2 // slot
-    # re-derive jit wrappers? _p1_run/_p2_run read globals at trace time;
-    # clear jit caches so each config retraces.
-    B._p1_run.clear_cache()
-    B._p2_run.clear_cache()
 
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, N, E).astype(np.int64)
+    dst = rng.integers(0, N, E).astype(np.int64)
+    x = jnp.asarray(rng.standard_normal((N, H), dtype=np.float32))
 
-for cfg in CONFIGS:
-    sb, ch, slot, rb, ch2, grt = cfg
-    if ch2 % slot or ch % slot:
-        continue
-    set_consts(sb, ch, slot, rb, ch2)
     t0 = time.time()
-    try:
-        plan = B._build_binned_plan_numpy(src, dst, N, N, group_row_target=grt)
-    except Exception as e:
-        print(f"{cfg}: plan build failed: {e}")
-        continue
+    plan = B._build_binned_plan_numpy(src, dst, N, N, group_row_target=grt)
     tb = time.time() - t0
     G, C1 = plan.p1_blk.shape
     C2 = plan.p2_obi.shape[1]
     pad1 = G * C1 * ch / E
     pad2 = G * C2 * ch2 / E
-    run = jax.jit(lambda x, plan: jnp.sum(B.run_binned(x, plan)))
-    try:
+    interp = jax.default_backend() != "tpu"   # CPU smoke: interpret mode
+    run = jax.jit(lambda x, plan: jnp.sum(B.run_binned(x, plan, interp)))
+    v = float(np.asarray(run(x, plan)))     # compile + correctness value
+    t = time.perf_counter()
+    for _ in range(5):
         out = run(x, plan)
-        v = float(np.asarray(out))
-        t = time.perf_counter()
-        for _ in range(5):
-            out = run(x, plan)
-        _ = np.asarray(out)
-        dt = (time.perf_counter() - t) / 5
-    except Exception as e:
-        print(f"{cfg}: run failed: {type(e).__name__}: {str(e)[:120]}")
-        continue
-    if ref is None:
-        ref = v
-    ok = abs(v - ref) / max(abs(ref), 1) < 1e-3
+    _ = np.asarray(out)
+    dt = (time.perf_counter() - t) / 5
     print(f"SB={sb} CH={ch} SLOT={slot} RB={rb} CH2={ch2} grt={grt}: "
           f"{dt*1e3:.1f} ms  (G={G} C1={C1} C2={C2} pad1={pad1:.2f} "
-          f"pad2={pad2:.2f} build={tb:.0f}s match={ok})", flush=True)
+          f"pad2={pad2:.2f} build={tb:.0f}s checksum={v:.6g})", flush=True)
+
+
+def main():
+    if len(sys.argv) == 7:                  # child mode
+        run_one(*(int(a) for a in sys.argv[1:]))
+        return
+    for cfg in CONFIGS:
+        sb, ch, slot, rb, ch2, grt = cfg
+        if ch2 % slot or ch % slot:
+            print(f"{cfg}: skipped (SLOT must divide CH and CH2)")
+            continue
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)]
+                + [str(v) for v in cfg],
+                timeout=CHILD_TIMEOUT_S, capture_output=True, text=True)
+            out = (r.stdout or "").strip()
+            if r.returncode != 0:
+                lines = (r.stderr or "").strip().splitlines()
+                err = next((ln for ln in reversed(lines)
+                            if "Error" in ln or "error" in ln),
+                           lines[-1] if lines else "")
+                print(f"{cfg}: FAILED rc={r.returncode}: {err[:200]}",
+                      flush=True)
+            elif out:
+                print(out.splitlines()[-1], flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"{cfg}: TIMEOUT after {CHILD_TIMEOUT_S}s "
+                  f"(wedged compile?)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
