@@ -225,7 +225,7 @@ def run():
             raise
         print(f"# auto backend failed ({type(e).__name__}: "
               f"{str(e)[:200]}); falling back to matmul", file=sys.stderr)
-        fallback_from = f"{type(e).__name__}"
+        fallback_from = type(e).__name__
     if fallback_from is not None:   # outside except: drop the failed
         trainer = build_and_warm("matmul")   # trainer's HBM before rebuild
     t1 = time.perf_counter()

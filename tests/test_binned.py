@@ -121,11 +121,9 @@ def test_plan_backend_avg_matches_xla(backend):
     ds = datasets.synthetic("avg-fast", 900, 5.0, 16, 4,
                             n_train=300, n_val=100, n_test=100, seed=9)
     # op-level: aggregate(x, "avg") vs the xla oracle
-    import jax.numpy as jnp
     g = ds.graph
     x = jnp.asarray(np.random.default_rng(1).standard_normal(
         (g.num_nodes, 16), dtype=np.float32))
-    from roc_tpu import ops
     want = np.asarray(ops.scatter_gather(
         x, jnp.asarray(g.col_idx, jnp.int32), jnp.asarray(g.dst_idx,
                                                           jnp.int32),
