@@ -92,6 +92,27 @@ def test_matmul_fast_precision_on_hw():
     assert not np.allclose(fast, exact)   # bf16 rounding must be present
 
 
+def test_binned_no_pipeline_fallback_on_hw():
+    """The single-buffered phase-1 fallback (ROC_BINNED_NO_PIPELINE=1, the
+    bisection baseline if the pipelined kernel misbehaves on a new Mosaic)
+    must also compile and match on hardware."""
+    import os
+
+    from roc_tpu.ops.pallas import binned as B
+    n, t, src, dst, x = next(_cases())
+    plan = B.build_binned_plan(src, dst, n, t, group_row_target=1 << 17)
+    os.environ["ROC_BINNED_NO_PIPELINE"] = "1"
+    B._p1_run.clear_cache()                 # env is read at trace time
+    try:
+        out = np.asarray(B.run_binned(jnp.asarray(x), plan,
+                                      interpret=False))
+    finally:
+        os.environ.pop("ROC_BINNED_NO_PIPELINE", None)
+        B._p1_run.clear_cache()
+    ref = _oracle_bf16(x, src, dst, n)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=5e-2)
+
+
 def test_binned_avg_on_hw():
     """avg rides the binned sum backend divided by in-degree; check the
     full composition against the NumPy mean on the chip."""
@@ -114,4 +135,5 @@ if __name__ == "__main__":   # direct hardware run, no pytest/conftest
     test_matmul_backend_on_hw()
     test_matmul_fast_precision_on_hw()
     test_binned_avg_on_hw()
+    test_binned_no_pipeline_fallback_on_hw()
     print("tpu hardware tests: all ok")
