@@ -150,7 +150,8 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
                       ) -> BinnedPlan:
     """Host-side schedule: sort, slot-pad, and position every edge for both
     phases.  Big edge lists take the native C++ counting-sort builder
-    (O(E), ~8x the NumPy lexsort path — docs/PERF.md); the vectorized
+    (O(E), ~14x the NumPy lexsort path: 2.0 s vs 27.3 s at Reddit scale,
+    docs/PERF.md); the vectorized
     NumPy fallback below is the correctness oracle
     (tests/test_binned.py::test_native_plan_equals_numpy)."""
     from roc_tpu import native
