@@ -115,6 +115,14 @@ def _init_devices():
 
     import jax
 
+    try:
+        # Persistent compile cache: repeated bench invocations (backend
+        # sweeps, driver reruns) skip the 20-40 s XLA compiles.
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/roc_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass                       # cache is best-effort, never fatal
     devs = jax.devices()
     print(f"# backend up: {jax.default_backend()} x{len(devs)}",
           file=sys.stderr)
