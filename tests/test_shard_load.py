@@ -137,3 +137,50 @@ def test_perhost_single_process_path(roc_dir):
     np.testing.assert_array_equal(local.edge_src, part.edge_src)
     np.testing.assert_array_equal(lhalo.edge_src_local, halo.edge_src_local)
     np.testing.assert_array_equal(lhalo.send_idx, halo.send_idx)
+
+
+def test_perhost_binned_plans_equal_singlehost(roc_dir):
+    """Per-host binned plan construction (the pod-scale path for the
+    hardware fast backend) must equal the single-host build row-for-row:
+    the allgathered chunk-count floors make every process compile the same
+    static program, so each local stack is exactly its slice of the global
+    stack."""
+    from roc_tpu.parallel.spmd import _build_shard_plans
+
+    prefix, ds = roc_dir
+    path = prefix + lux.LUX_SUFFIX
+    num_parts, nproc = 8, 4
+    part = partition_graph(ds.graph, num_parts)
+    halo = build_halo_maps(part)
+    S = part.shard_nodes
+    table_rows = S + num_parts * halo.K
+    want = _build_shard_plans("binned", halo.edge_src_local, part.edge_dst,
+                              S, table_rows)
+
+    L = num_parts // nproc
+    ag = ThreadAllGather(nproc)
+
+    def per_process(i):
+        allg = ag.for_process(i)
+        meta = shard_load.meta_from_lux(path, num_parts, process_index=i,
+                                        allgather=allg)
+        local = shard_load.load_local_shards(
+            path, meta, list(range(i * L, (i + 1) * L)))
+        lhalo = shard_load.build_halo_local(meta, local, allgather=allg)
+        assert lhalo.K == halo.K
+        return _build_shard_plans("binned", lhalo.edge_src_local,
+                                  local.edge_dst, S, table_rows,
+                                  allgather=allg)
+
+    results = _run_threads(nproc, per_process)
+    fields = ("p1_srcl", "p1_off", "p1_blk", "p2_dstl", "p2_obi", "p2_first")
+    for i, got in enumerate(results):
+        ids = list(range(i * L, (i + 1) * L))
+        for side in ("fwd", "bwd"):
+            w, g = getattr(want, side), getattr(got, side)
+            assert (g.num_rows, g.table_rows, g.bins_per_group) == \
+                (w.num_rows, w.table_rows, w.bins_per_group)
+            for f in fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(g, f)), np.asarray(getattr(w, f))[ids],
+                    err_msg=f"proc {i} {side}.{f}")
