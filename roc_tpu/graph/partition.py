@@ -257,6 +257,24 @@ def edge_block_arrays(g: Csr, part: PartitionMeta):
     return src.reshape(P, Eb), dst.reshape(P, Eb)
 
 
+def edge_block_arrays_t(g: Csr, part: PartitionMeta):
+    """Transposed edge blocks for the backward of edge-sharded aggregation:
+    the gradient flow dx[u] = Σ_{e: src(e)=u} g[dst(e)] is itself an edge
+    aggregation with roles swapped, so the same exactly-equal cuts apply to
+    the *src*-sorted edge list.  Sorting by src makes each block's scatter
+    targets a contiguous padded-id range — the property the windowed chunk
+    plans need (mirrors the reference re-launching its forward kernel with
+    roles swapped, scattergather_kernel.cu:160-170, at block granularity).
+
+    Returns (gather [P, Eb], scatter [P, Eb]): gather = padded dst ids
+    (rows of the all-gathered gradient), scatter = padded src ids,
+    nondecreasing within each block.  Implemented as edge_block_arrays of
+    the transposed CSR so the pad-edge recipe lives in exactly one place
+    (Csr.transpose's stable sort makes this element-identical to sorting
+    the in-edge list by src)."""
+    return edge_block_arrays(g.transpose(), part)
+
+
 def partition_graph(g: Csr, num_parts: int) -> Partition:
     """Partition + pad a CSR into the static shard layout described above."""
     g.validate()

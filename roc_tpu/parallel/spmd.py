@@ -37,7 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from roc_tpu import ops
 from roc_tpu.graph.partition import (Partition, edge_block_arrays,
-                                     partition_graph)
+                                     edge_block_arrays_t, partition_graph)
 from roc_tpu.models.model import GraphCtx
 from roc_tpu.parallel.halo import HaloMaps, build_halo_maps
 from roc_tpu.ops.softmax import MASK_NONE
@@ -75,6 +75,126 @@ jax.tree_util.register_dataclass(
     data_fields=["edge_src", "edge_dst", "in_degree", "send_idx",
                  "ring_src", "ring_dst", "plans"],
     meta_fields=["backend", "mode", "precision"])
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgePlans:
+    """Windowed chunk plans for edge-sharded matmul aggregation.
+
+    Each block's scatter targets are a contiguous padded-id range (fwd:
+    dst-sorted cuts; bwd: src-sorted cuts — edge_block_arrays[_t]), so
+    plans are built over a common ``span``-row window per direction and
+    placed into the global [P*S] accumulator at a per-block ``base``.
+    Plan size is O(E/P + span/VB) per block instead of O(P*S/VB) — the
+    empty-window chunk floor does not grow with the mesh.
+    Array leaves carry a leading [P] axis (sharded); spans are static."""
+    fwd_obi: jnp.ndarray      # [P, Cf]
+    fwd_first: jnp.ndarray
+    fwd_edst: jnp.ndarray     # [P, Cf, EB] window-local scatter ids
+    fwd_esrc: jnp.ndarray     # [P, Cf, EB] global gather ids
+    fwd_base: jnp.ndarray     # [P] int32 window base row
+    bwd_obi: jnp.ndarray
+    bwd_first: jnp.ndarray
+    bwd_edst: jnp.ndarray
+    bwd_esrc: jnp.ndarray
+    bwd_base: jnp.ndarray
+    span_fwd: int = dataclasses.field(metadata={"static": True}, default=0)
+    span_bwd: int = dataclasses.field(metadata={"static": True}, default=0)
+
+
+jax.tree_util.register_dataclass(
+    EdgePlans,
+    data_fields=["fwd_obi", "fwd_first", "fwd_edst", "fwd_esrc", "fwd_base",
+                 "bwd_obi", "bwd_first", "bwd_edst", "bwd_esrc", "bwd_base"],
+    meta_fields=["span_fwd", "span_bwd"])
+
+
+def _windowed_block_plans(gather, scatter, NS: int):
+    """Per-block chunk plans over each block's contiguous scatter window.
+
+    gather/scatter: [P, Eb] padded-global ids, scatter nondecreasing per
+    block.  Returns (obi, first, edst, esrc stacked [P, C(, EB)],
+    base [P], span)."""
+    from roc_tpu.ops.pallas.segment_sum import VB, build_chunk_plan, \
+        pad_chunks
+
+    P_ = scatter.shape[0]
+    bases = (scatter.min(axis=1) // VB) * VB
+    span = int((scatter.max(axis=1) + 1 - bases).max())
+    span = min(-(-span // VB) * VB, NS)
+    # The accumulator has exactly NS rows, so base + span <= NS must hold
+    # (dynamic_update_slice would otherwise clamp the start and shift the
+    # block's sums onto wrong rows).  Relative ids still fit: scatter.max
+    # <= NS - 1 <= base + span - 1.
+    bases = np.minimum(bases, NS - span)
+    plans = [build_chunk_plan(
+        np.asarray(gather[p], np.int32),
+        np.asarray(scatter[p] - bases[p], np.int32), span)
+        for p in range(P_)]
+    for pl in plans:   # same invariant build_aggregate_plans pins
+        assert np.all(np.diff(np.asarray(pl.obi)) <= 1)
+    C = max(pl.obi.shape[0] for pl in plans)
+    padded = [pad_chunks(pl.obi, pl.first, pl.edst, pl.esrc,
+                         C - pl.obi.shape[0], jnp) for pl in plans]
+    stack = [jnp.stack([q[i] for q in padded]) for i in range(4)]
+    return stack[0], stack[1], stack[2], stack[3], \
+        jnp.asarray(bases, jnp.int32), span
+
+
+def build_edge_plans(graph, meta, fwd_arrays=None) -> EdgePlans:
+    """Fwd + transposed-bwd windowed plans for edge-sharded aggregation.
+    ``fwd_arrays``: pass an existing edge_block_arrays(graph, meta) result
+    to skip rebuilding it."""
+    NS = meta.num_parts * meta.shard_nodes
+    f_gat, f_sct = fwd_arrays if fwd_arrays is not None \
+        else edge_block_arrays(graph, meta)
+    b_gat, b_sct = edge_block_arrays_t(graph, meta)
+    fo, ff, fd, fs, fb, span_f = _windowed_block_plans(f_gat, f_sct, NS)
+    bo, bf, bd, bs, bb, span_b = _windowed_block_plans(b_gat, b_sct, NS)
+    return EdgePlans(fwd_obi=fo, fwd_first=ff, fwd_edst=fd, fwd_esrc=fs,
+                     fwd_base=fb, bwd_obi=bo, bwd_first=bf, bwd_edst=bd,
+                     bwd_esrc=bs, bwd_base=bb,
+                     span_fwd=span_f, span_bwd=span_b)
+
+
+def _edge_mm_half(x, obi, edst, esrc, base, span: int, precision):
+    """One direction of the edge-mode aggregation: all-gather the source
+    table, windowed scatter-free sum over this block's edges, place at the
+    block's window base in the global accumulator, reduce onto owners."""
+    from roc_tpu.ops.aggregate import _matmul_run
+    table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)    # [P*S, H]
+    NS, H = table.shape
+    part_loc = _matmul_run(table, obi, edst, esrc, span, precision)
+    acc = jnp.zeros((NS, H), part_loc.dtype) + 0 * part_loc[:1, :1]
+    acc = jax.lax.dynamic_update_slice(acc, part_loc, (base, 0))
+    return jax.lax.psum_scatter(acc, PARTS_AXIS, scatter_dimension=0,
+                                tiled=True)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def edge_aggregate_matmul(x, plans: EdgePlans, precision):
+    """Edge-sharded sum aggregation on the matmul backend (inside
+    shard_map; plans fields are this shard's blocks).  The backward is the
+    same computation over the transposed (src-sorted) blocks — AD's
+    transpose of the gather would emit the serialized TPU scatter this
+    backend exists to avoid, hence the custom vjp."""
+    return _edge_mm_half(x, plans.fwd_obi, plans.fwd_edst, plans.fwd_esrc,
+                         plans.fwd_base, plans.span_fwd, precision)
+
+
+def _ea_fwd(x, plans, precision):
+    return edge_aggregate_matmul(x, plans, precision), plans
+
+
+def _ea_bwd(precision, plans, g):
+    dx = _edge_mm_half(g, plans.bwd_obi, plans.bwd_edst, plans.bwd_esrc,
+                       plans.bwd_base, plans.span_bwd, precision)
+    zero = jax.tree.map(
+        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
+    return dx, zero
+
+
+edge_aggregate_matmul.defvjp(_ea_fwd, _ea_bwd)
 
 
 def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
@@ -218,11 +338,17 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
                 raise ValueError(
                     f"edge-sharded aggregation supports sum/avg, not {aggr}"
                     " (use vertex sharding for max/min models)")
-            table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)  # [P*S, H]
-            partial = ops.scatter_gather(table, edge_src, edge_dst,
-                                         table.shape[0], "sum")
-            out = jax.lax.psum_scatter(partial, PARTS_AXIS,
-                                       scatter_dimension=0, tiled=True)
+            if gd_block.plans is not None:      # matmul backend: scatter-free
+                out = edge_aggregate_matmul(
+                    x, gd_block.plans,
+                    ops.matmul_precision(gd_block.precision))
+            else:
+                table = jax.lax.all_gather(x, PARTS_AXIS,
+                                           tiled=True)  # [P*S, H]
+                partial = ops.scatter_gather(table, edge_src, edge_dst,
+                                             table.shape[0], "sum")
+                out = jax.lax.psum_scatter(partial, PARTS_AXIS,
+                                           scatter_dimension=0, tiled=True)
             if aggr == "avg":   # all in-edges of a vertex => count = degree
                 out = ops.divide_by_degree(out, gd_block.in_degree)
             return out
@@ -327,11 +453,20 @@ class SpmdTrainer(BaseTrainer):
             self.halo = None
             eb_src, eb_dst = edge_block_arrays(ds.graph, self.part.meta)
             assert self.part.num_parts * self.part.shard_nodes < 2**31
+            plans = None
+            if backend == "matmul":
+                # Windowed one-hot plans per block (TPU would otherwise
+                # serialize each block's scatter); backward rides the
+                # src-sorted transposed blocks via edge_aggregate_matmul's
+                # custom vjp.
+                plans = build_edge_plans(ds.graph, self.part.meta,
+                                         fwd_arrays=(eb_src, eb_dst))
             return ShardedGraphData(
                 edge_src=jnp.asarray(eb_src, jnp.int32),
                 edge_dst=jnp.asarray(eb_dst, jnp.int32),
                 in_degree=jnp.asarray(self.part.in_degree, jnp.float32),
-                send_idx=None, plans=None, backend=backend, mode="edge")
+                send_idx=None, plans=plans, backend=backend, mode="edge",
+                precision=cfg.aggregate_precision)
         if self._exchange_mode == "ring":
             from roc_tpu.parallel.ring import build_ring_groups
             self.halo = None

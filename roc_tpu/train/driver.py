@@ -172,14 +172,26 @@ class BaseTrainer:
         (avg = plan-sum / in-degree); don't pay plan construction when the
         built model contains neither."""
         cfg = self.config
-        if self._use_edge_shard:
-            # edge-sharded aggregation is its own data path (psum_scatter of
-            # per-block partial sums); the plan backends don't apply to it
-            if cfg.aggregate_backend not in ("auto", "xla"):
-                print(f"# -edge-shard ignores aggregate_backend="
-                      f"{cfg.aggregate_backend}; using xla")
-            return "xla"
         g = self.dataset.graph
+        if self._use_edge_shard:
+            # Edge-sharded aggregation supports xla and matmul (windowed
+            # per-block one-hot plans, spmd.edge_aggregate_matmul); the
+            # binned kernels' (block x bin) schedule does not apply there.
+            backend = resolve_backend(cfg.aggregate_backend, g.num_edges)
+            if backend == "binned":
+                backend = "matmul"
+            if backend == "matmul" \
+                    and not ({"sum", "avg"} & self._model_aggrs()):
+                if cfg.aggregate_backend != "auto":
+                    print(f"# aggregate_backend={cfg.aggregate_backend} "
+                          f"only accelerates sum/avg aggregation under "
+                          f"-edge-shard; using xla")
+                return "xla"
+            if backend == "matmul" and cfg.aggregate_backend in (
+                    "binned", "pallas"):
+                print("# -edge-shard supports xla|matmul aggregation; "
+                      "using matmul")
+            return backend
         backend = resolve_backend(cfg.aggregate_backend, g.num_edges,
                                   g.num_nodes, g.num_nodes)
         aggrs = self._model_aggrs()
