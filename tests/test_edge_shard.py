@@ -203,3 +203,18 @@ def test_edge_plans_are_windowed():
         bases = np.asarray(getattr(ep, f"{side}_base"))
         assert bases.min() >= 0
         assert bases.max() + span <= NS
+
+
+def test_edge_shard_matmul_bf16_smoke():
+    """bf16 activations through the edge-mode custom vjp (all_gather +
+    windowed one-hot dots + psum_scatter must all keep bf16 happy)."""
+    ds = small_ds(seed=31)
+    cfg = Config(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=2,
+                 dropout_rate=0.0, num_parts=4, edge_shard=True,
+                 eval_every=10**9, aggregate_backend="matmul",
+                 use_bf16=True, seed=3)
+    t = SpmdTrainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    assert t.gdata.backend == "matmul" and t.gdata.plans is not None
+    for _ in range(2):
+        loss = t.run_epoch()
+    assert np.isfinite(float(loss))
