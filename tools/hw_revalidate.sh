@@ -1,0 +1,38 @@
+#!/bin/bash
+# One-shot hardware revalidation after a tunnel outage (or a new round).
+# Runs in order, stopping notes into /tmp/hw_revalidate.log:
+#   1. TPU-gated kernel tests (incl. H=41, fallback kernel, avg)
+#   2. bench.py on auto (binned where viable) — the headline number
+#   3. group-count sweep via ROC_BINNED_GROUP_ROWS
+#   4. constant sweep round 2 (subprocess-isolated)
+# Usage:  bash tools/hw_revalidate.sh  (from the repo root, tunnel healthy)
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/hw_revalidate.log
+: > "$LOG"
+
+note() { echo "== $*" | tee -a "$LOG"; }
+
+note "probe"
+timeout 60 python -c "import jax; print(jax.devices())" 2>&1 | tail -1 \
+    | tee -a "$LOG" || { note "tunnel down; aborting"; exit 1; }
+
+note "1. TPU-gated kernel tests"
+PYTHONPATH=/root/.axon_site:$PWD timeout 1200 python tests/test_tpu_hw.py \
+    2>&1 | tail -3 | tee -a "$LOG"
+
+note "2. bench auto (expect binned, ~0.7 s/epoch)"
+timeout 1800 python bench.py 2>&1 | tail -3 | tee -a "$LOG"
+
+note "3. group-count sweep (fewer groups -> less phase-1 rounding)"
+for grt in 2097152 4194304 8388608; do
+    note "   ROC_BINNED_GROUP_ROWS=$grt"
+    ROC_BINNED_GROUP_ROWS=$grt ROC_BENCH_BACKEND=binned \
+        timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+done
+
+note "4. constant sweep round 2"
+timeout 5400 python tools/sweep_binned.py 2>&1 | tee -a "$LOG"
+
+note "done — record winners in docs/PERF.md + BASELINE.md, update"
+note "ROC_BINNED_GROUP_ROWS default / native BN_* constants if changed"
