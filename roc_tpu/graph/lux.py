@@ -208,6 +208,9 @@ def load_mask(prefix: str, num_nodes: int) -> np.ndarray:
 def write_dataset(prefix: str, g: Csr, feats: np.ndarray, label_ids: np.ndarray,
                   mask: np.ndarray) -> None:
     """Write a full ROC-format dataset (graph + sidecars) under `prefix`."""
+    parent = os.path.dirname(prefix)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     write_lux(prefix + LUX_SUFFIX, g)
     np.savetxt(prefix + ".feats.csv", feats, delimiter=",", fmt="%.6g")
     np.savetxt(prefix + ".label", label_ids.reshape(-1, 1), fmt="%d")
