@@ -155,3 +155,24 @@ def test_cli_round2_flags_parse():
     assert cfg3.edge_shard == "auto" and cfg3.exchange_mode() == "halo"
     cfg4 = parse_args(["-file", "x", "-layers", "8-4", "-no-halo"])
     assert cfg4.exchange_mode() == "allgather"
+
+
+def test_profile_flag_writes_trace(tmp_path):
+    """-profile must produce a jax.profiler trace of epochs 3-5 (SURVEY
+    §5.1: profiling is a first-class aux system here, absent upstream)."""
+    import os
+
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("prof", 120, 3.0, 8, 3, n_train=30, n_val=30,
+                            n_test=30, seed=6)
+    cfg = Config(layers=[8, 8, 3], num_epochs=6, dropout_rate=0.0,
+                 eval_every=10**9, profile_dir=str(tmp_path / "tr"))
+    Trainer(cfg, ds, build_gcn(cfg.layers, 0.0)).train(
+        print_fn=lambda *_: None)
+    files = [os.path.join(r, f)
+             for r, _, fs in os.walk(tmp_path / "tr") for f in fs]
+    assert any("xplane" in f or "trace" in f for f in files), files
