@@ -252,6 +252,8 @@ def run():
         "value": round(epoch_s, 4),
         "unit": "s",
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3),
+        "backend": resolved,                   # what auto resolved to
+        "platform": jax.default_backend(),
     }
     if fallback_from is not None:
         result["fallback"] = f"auto failed ({fallback_from}); ran matmul"
