@@ -1,0 +1,39 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU tunnel until it answers, then fire the
+# one-shot hardware revalidation (tools/hw_revalidate.sh) exactly once.
+# Runs detached for up to WATCH_HOURS (default 11).  Progress/log:
+#   /tmp/tpu_watch.log      probe history
+#   /tmp/hw_revalidate.log  revalidation output (written by hw_revalidate.sh)
+#   /tmp/tpu_watch.done     exists once revalidation has completed
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_watch.log
+DONE=/tmp/tpu_watch.done
+HOURS=${WATCH_HOURS:-11}
+DEADLINE=$(( $(date +%s) + HOURS * 3600 ))
+rm -f "$DONE"
+echo "watcher start $(date -u +%FT%TZ), deadline in ${HOURS}h" >> "$LOG"
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if timeout 60 env PYTHONPATH=/root/.axon_site JAX_PLATFORMS=axon \
+        python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
+        echo "tunnel UP $(date -u +%FT%TZ) — running hw_revalidate" >> "$LOG"
+        # Same env the probe validated: without /root/.axon_site on
+        # PYTHONPATH the plugin never registers and the revalidation would
+        # silently bench on CPU.
+        PYTHONPATH=/root/.axon_site JAX_PLATFORMS=axon \
+            bash tools/hw_revalidate.sh >> "$LOG" 2>&1
+        rc=$?
+        echo "revalidate rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE"
+            exit 0
+        fi
+        # Tunnel flapped mid-revalidation: keep watching the window.
+        echo "revalidate failed; resuming probe loop" >> "$LOG"
+    fi
+    echo "tunnel down $(date -u +%FT%TZ); sleeping 240s" >> "$LOG"
+    sleep 240
+done
+echo "watcher deadline reached without a healthy window" >> "$LOG"
+exit 1
