@@ -1,0 +1,142 @@
+"""Dataset converters (roc_tpu/graph/convert.py): edge-list and OGB-style
+dumps -> ROC on-disk format, plus the vendored *real* graph (Zachary's
+karate club) and its golden semi-supervised curve.
+
+The reference ships no converter (its datasets were prepared out-of-tree,
+test.sh:8); SURVEY §7.1 calls for one.  The karate test is the repo's one
+real-data accuracy oracle: the GCN must reproduce the published result
+(Zachary 1977's model: 33/34 members, node 8 the sole miss)."""
+
+import numpy as np
+import pytest
+
+from roc_tpu.graph import convert, datasets, lux
+from roc_tpu.models import build_model
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+
+def _write(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def test_edge_list_basic(tmp_path):
+    _write(tmp_path / "g.txt", "# comment\n0 1\n1 2\n2,0\n\n")
+    ds = convert.from_edge_list(str(tmp_path / "g.txt"))
+    assert ds.graph.num_nodes == 3
+    # 3 directed edges + 3 self-edges
+    assert ds.graph.num_edges == 6
+    assert ds.in_dim == 3                      # identity features
+    np.testing.assert_array_equal(ds.features, np.eye(3, dtype=np.float32))
+
+
+def test_edge_list_undirected_dedups(tmp_path):
+    # both orientations listed + a duplicate: symmetrize must dedup
+    _write(tmp_path / "g.txt", "0 1\n1 0\n0 1\n1 2\n")
+    ds = convert.from_edge_list(str(tmp_path / "g.txt"), undirected=True,
+                                self_edges=False)
+    assert ds.graph.num_edges == 4             # 0<->1, 1<->2
+    t = ds.graph.transpose()                   # undirected: CSR == CSR^T
+    np.testing.assert_array_equal(ds.graph.row_ptr, t.row_ptr)
+    np.testing.assert_array_equal(ds.graph.col_idx, t.col_idx)
+
+
+def test_edge_list_sidecars_and_roundtrip(tmp_path):
+    _write(tmp_path / "g.txt", "0 1\n1 2\n3 0\n")
+    _write(tmp_path / "f.csv", "1,0\n0,1\n1,1\n0,0\n")
+    _write(tmp_path / "l.txt", "0\n1\n1\n0\n")
+    ds = convert.from_edge_list(
+        str(tmp_path / "g.txt"), feats_path=str(tmp_path / "f.csv"),
+        labels_path=str(tmp_path / "l.txt"), split=(2, 1, 1), seed=0)
+    assert ds.num_classes == 2 and ds.in_dim == 2
+    convert.write(ds, str(tmp_path / "out"))
+    back = datasets.load_roc_dataset(str(tmp_path / "out"), 2, 2)
+    np.testing.assert_array_equal(back.graph.row_ptr, ds.graph.row_ptr)
+    np.testing.assert_array_equal(back.graph.col_idx, ds.graph.col_idx)
+    np.testing.assert_allclose(back.features, ds.features, atol=1e-6)
+    np.testing.assert_array_equal(back.label_ids, ds.label_ids)
+    np.testing.assert_array_equal(back.mask, ds.mask)
+
+
+def test_edge_list_out_of_range(tmp_path):
+    _write(tmp_path / "g.txt", "0 7\n")
+    with pytest.raises(ValueError, match="out of range"):
+        convert.from_edge_list(str(tmp_path / "g.txt"), num_nodes=4)
+    _write(tmp_path / "neg.txt", "5 -1\n0 1\n")
+    with pytest.raises(ValueError, match="out of range"):
+        convert.from_edge_list(str(tmp_path / "neg.txt"), num_nodes=10,
+                               undirected=True)
+
+
+def test_edge_list_keeps_input_self_loops(tmp_path):
+    # a self-loop in the input must survive symmetrization even when
+    # self_edges=False (no uniform re-add)
+    _write(tmp_path / "g.txt", "2 2\n0 1\n")
+    ds = convert.from_edge_list(str(tmp_path / "g.txt"), undirected=True,
+                                self_edges=False)
+    assert ds.graph.num_edges == 3          # 0<->1 + the (2,2) loop
+    src, dst = ds.graph.col_idx, ds.graph.dst_idx
+    assert ((src == 2) & (dst == 2)).sum() == 1
+
+
+def test_stratified_split_covers_classes():
+    ids = np.array([0] * 50 + [1] * 30 + [2] * 20)
+    mask = convert.stratified_split(ids, 6, 10, 20, seed=3)
+    train = ids[mask == lux.MASK_TRAIN]
+    assert (mask == lux.MASK_TRAIN).sum() == 6
+    assert (mask == lux.MASK_VAL).sum() == 10
+    assert (mask == lux.MASK_TEST).sum() == 20
+    assert set(np.unique(train)) == {0, 1, 2}   # every class in train
+
+
+def test_ogb_dir(tmp_path):
+    root = tmp_path / "raw"
+    root.mkdir()
+    (root / "split").mkdir()
+    _write(root / "edge.csv", "0,1\n1,2\n2,3\n")
+    _write(root / "node-feat.csv", "1,0\n0,1\n1,1\n0,0\n")
+    _write(root / "node-label.csv", "0\n1\n1\n0\n")
+    _write(root / "split" / "train.csv", "0\n1\n")
+    _write(root / "split" / "valid.csv", "2\n")
+    _write(root / "split" / "test.csv", "3\n")
+    ds = convert.from_ogb_dir(str(root))
+    assert ds.graph.num_nodes == 4
+    # 3 undirected pairs = 6 directed + 4 self-edges
+    assert ds.graph.num_edges == 10
+    np.testing.assert_array_equal(
+        ds.mask, [lux.MASK_TRAIN, lux.MASK_TRAIN, lux.MASK_VAL,
+                  lux.MASK_TEST])
+
+
+def test_karate_is_the_real_graph():
+    ds = convert.karate_club()
+    assert ds.graph.num_nodes == 34
+    assert ds.graph.num_edges == 2 * 78 + 34   # symmetrized + self-edges
+    # the observed fission outcome as recorded in the networkx dataset:
+    # 17 members with Mr. Hi, 17 with the officers
+    assert int((ds.label_ids == 0).sum()) == 17
+    assert int((ds.label_ids == 1).sum()) == 17
+    # canonical semi-supervised split: leaders train, everyone else test
+    assert list(np.nonzero(ds.mask == lux.MASK_TRAIN)[0]) == [0, 33]
+    assert int((ds.mask == lux.MASK_TEST).sum()) == 32
+
+
+@pytest.mark.slow
+def test_golden_karate_curve():
+    """Real-data golden curve (docs/GOLDEN.md): 2-layer GCN, identity
+    features, train = the two faction leaders only.  Must reproduce the
+    published result — 31/32 test members (33/34 overall, matching
+    Zachary's own model) with node 8 the sole structural miss."""
+    ds = convert.karate_club()
+    cfg = Config(layers=[34, 16, 2], num_epochs=100, learning_rate=0.01,
+                 weight_decay=5e-4, dropout_rate=0.5, eval_every=10**9)
+    tr = Trainer(cfg, ds, build_model("gcn", cfg.layers, cfg.dropout_rate,
+                                      "sum"))
+    for _ in range(100):
+        tr.run_epoch()
+    import jax
+    m = jax.device_get(tr.evaluate())
+    assert int(m.test_correct) == 31 and int(m.test_all) == 32
+    pred = np.argmax(np.asarray(tr.predict_logits()), axis=-1)
+    assert list(np.nonzero(pred != ds.label_ids)[0]) == [8]

@@ -31,8 +31,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         fi
         # Tunnel flapped mid-revalidation: keep watching the window.
         echo "revalidate failed; resuming probe loop" >> "$LOG"
+    else
+        echo "tunnel down $(date -u +%FT%TZ)" >> "$LOG"
     fi
-    echo "tunnel down $(date -u +%FT%TZ); sleeping 240s" >> "$LOG"
     sleep 240
 done
 echo "watcher deadline reached without a healthy window" >> "$LOG"
