@@ -50,7 +50,16 @@ def _env(name, default, cast):
 
 SCALE = _env("ROC_BENCH_SCALE", "1.0", float)
 NODES, IN_DIM, CLASSES = int(232_965 * SCALE), 602, 41
-LAYERS = [IN_DIM, 256, CLASSES]
+# ROC_BENCH_MODEL=gat measures the attention path (plan backend on TPU);
+# non-gcn runs annotate the metric name and report vs_baseline null (the
+# reference figure is a GCN number).  ROC_BENCH_LAYERS overrides the hidden
+# sizes (e.g. 602-64-41 with 4 heads = 256 total hidden for a GAT run
+# comparable to the canonical GCN).
+MODEL = os.environ.get("ROC_BENCH_MODEL", "gcn")
+HEADS = _env("ROC_BENCH_HEADS", "4", int)
+_layers_env = os.environ.get("ROC_BENCH_LAYERS", "")
+LAYERS = [int(v) for v in _layers_env.split("-")] if _layers_env \
+    else [IN_DIM, 256, CLASSES]
 AVG_DEG = 50.0
 WARMUP = 3
 MEASURED = _env("ROC_BENCH_EPOCHS", "10", int)
@@ -59,7 +68,9 @@ BACKEND = os.environ.get("ROC_BENCH_BACKEND", "auto")
 # one-hot dots; golden-curve-validated, docs/GOLDEN.md).  Overriding to
 # exact annotates the metric name so histories are never conflated.
 PRECISION = os.environ.get("ROC_BENCH_PRECISION", "fast")
-METRIC = ("gcn_reddit602-256-41_epoch_time"
+METRIC = (f"{MODEL}_reddit{'-'.join(map(str, LAYERS))}"
+          + (f"_heads{HEADS}" if MODEL == "gat" else "")
+          + "_epoch_time"
           + ("" if SCALE == 1.0 else f"_scale{SCALE:g}")
           + ("" if PRECISION == "fast" else f"_{PRECISION}"))
 
@@ -198,7 +209,7 @@ def run():
     import jax
 
     from roc_tpu.graph import datasets
-    from roc_tpu.models import build_gcn
+    from roc_tpu.models import build_model
     from roc_tpu.train.config import Config
     from roc_tpu.train.driver import Trainer, device_sync
 
@@ -221,12 +232,14 @@ def run():
         cfg = Config(layers=LAYERS, num_epochs=1, learning_rate=0.01,
                      weight_decay=1e-4, dropout_rate=0.5, eval_every=10**9,
                      num_parts=n_dev, halo=True, aggregate_backend=backend,
-                     aggregate_precision=PRECISION)
+                     aggregate_precision=PRECISION, model=MODEL, heads=HEADS)
+        model = build_model(MODEL, LAYERS, cfg.dropout_rate, "sum",
+                            heads=HEADS)
         if n_dev > 1:
             from roc_tpu.parallel.spmd import SpmdTrainer
-            tr = SpmdTrainer(cfg, ds, build_gcn(LAYERS, cfg.dropout_rate))
+            tr = SpmdTrainer(cfg, ds, model)
         else:
-            tr = Trainer(cfg, ds, build_gcn(LAYERS, cfg.dropout_rate))
+            tr = Trainer(cfg, ds, model)
         # device_sync fetches the loss to the host: each epoch's params feed
         # the next, so syncing the last loss transitively waits on every
         # step.  Warmup doubles as the compile check for the fallback below.
@@ -268,14 +281,16 @@ def run():
         "metric": METRIC,
         "value": round(epoch_s, 4),
         "unit": "s",
-        "vs_baseline": round(REF_EPOCH_S / epoch_s, 3),
+        # the reference figure is a GCN number; other models report null
+        "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
+        if MODEL == "gcn" else None,
         "backend": resolved,                   # what auto resolved to
         "platform": jax.default_backend(),
     }
     if fallback_from is not None:
         result["fallback"] = f"auto failed ({fallback_from}); ran matmul"
     if (result["platform"] not in ("cpu",) and result["value"] is not None
-            and SCALE == 1.0 and PRECISION == "fast"
+            and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

@@ -132,6 +132,13 @@ def _finish(name: str, num_nodes: int, src: np.ndarray, dst: np.ndarray,
         label_ids = np.zeros(num_nodes, dtype=np.int64)
     label_ids = np.asarray(label_ids, dtype=np.int64).reshape(-1)
     assert label_ids.shape[0] == num_nodes
+    if label_ids.min() < 0:
+        # OGB marks unlabeled nodes -1; one_hot's fancy indexing would wrap
+        # that to the LAST class and the split would train on fabricated
+        # labels — refuse instead of corrupting silently.
+        raise ValueError(
+            "negative label ids (unlabeled-node markers?) — remap them to a "
+            "real class or supply a mask that excludes those nodes")
     num_classes = int(label_ids.max()) + 1
     if mask is None:
         if split is None:

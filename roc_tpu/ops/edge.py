@@ -16,8 +16,12 @@ routes them to pad destination rows (partition.py edge padding invariants).
 
 from __future__ import annotations
 
+from functools import partial
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def edge_softmax(scores, edge_dst, num_nodes: int):
@@ -136,5 +140,325 @@ def _chunked_gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
     o0 = jnp.zeros((num_nodes + 1, K, F), h.dtype)
     (z, out), _ = jax.lax.scan(
         jax.checkpoint(acc_body, prevent_cse=False), (z0, o0), (src, dst))
+    # 1e-20, not 1e-38: subnormal guards flush to zero under XLA and rows
+    # with no in-edges would hit 0/0 (live rows have z >= 1 by the max shift)
     return (out[:num_nodes]
-            / jnp.maximum(z[:num_nodes], 1e-38)[:, :, None])
+            / jnp.maximum(z[:num_nodes], 1e-20)[:, :, None])
+
+
+# ---------------------------------------------------------------------------
+# Plan-backend attention: edge softmax + weighted aggregation without a
+# single TPU scatter, forward OR backward.
+# ---------------------------------------------------------------------------
+#
+# The scan paths above scatter-add per edge chunk (`.at[].add` / `.at[].max`)
+# — the exact per-index-serializing lowering the sum backends were built to
+# avoid (~6.5 s/aggregation at Reddit scale, ops/aggregate.py).  Here every
+# segment reduction rides the same host-built chunk schedule as the matmul
+# sum backend (ops/pallas/segment_sum.py), with two twists:
+#   * plans are built over EDGE POSITIONS: each (chunk, slot) carries both
+#     `pos` (the edge's index into [E, ...] edge arrays) and `nid` (its
+#     endpoint's row in the node table), so per-edge quantities (scores,
+#     exp-weights) and node gathers compose inside one scan step;
+#   * two directions are prebuilt — dst-keyed (forward softmax/aggregate)
+#     and src-keyed (the backward reductions onto the source table) — the
+#     same role swap the reference performs by relaunching its forward
+#     kernel transposed (scattergather_kernel.cu:160-170).
+# Segment-max (the softmax shift) is the same one-hot window machinery with
+# masked max in place of the MXU dot.
+#
+# The full GAT layer is a custom_vjp (gat_attend_plan) whose hand-derived
+# backward is built from these primitives plus plain gathers — autodiff of
+# the forward would otherwise transpose every gather into a scatter.
+
+_PLAN_CB_SUM = 512   # chunks per scan step, one-hot dot passes
+_PLAN_CB_MAX = 128   # smaller: the masked-max intermediate is [cb, cb, VB, K]
+
+
+class GatPlans(NamedTuple):
+    """Dst- and src-keyed edge-position chunk schedules (jit-traceable
+    int32 arrays; stackable on a leading parts axis for shard_map).
+
+    dst_*: chunks over the dst-sorted edge list, windows = destination rows
+           (num_rows).  ``pos`` indexes [E,...] edge arrays (dst order);
+           ``nid`` is the edge's SOURCE row in the feature table.
+    src_*: chunks over the src-sorted edge list, windows = table rows
+           (table_rows).  ``pos`` again indexes dst-ordered edge arrays
+           (the src-sort permutation is folded in); ``nid`` is the edge's
+           DESTINATION row.
+    """
+    dst_obi: jnp.ndarray    # [Cd]
+    dst_edst: jnp.ndarray   # [Cd, EB] window-local dst row, VB on pads
+    dst_pos: jnp.ndarray    # [Cd, EB]
+    dst_nid: jnp.ndarray    # [Cd, EB]
+    src_obi: jnp.ndarray    # [Cs]
+    src_edst: jnp.ndarray   # [Cs, EB]
+    src_pos: jnp.ndarray    # [Cs, EB]
+    src_nid: jnp.ndarray    # [Cs, EB]
+    num_rows: int           # static: dst windows cover [0, num_rows)
+    table_rows: int         # static: src windows cover [0, table_rows)
+
+
+def _position_plan(keys_sorted, pos, nids_by_pos, num_rows):
+    """Chunk plan over (position, key) pairs: esrc slots carry positions
+    (indices into the canonical dst-ordered edge arrays); nid is gathered
+    host-side so the device never indexes edge_src/edge_dst at runtime.
+    ``nids_by_pos`` must be indexed by POSITION VALUE (dst order), not by
+    slot order — the plan stores positions, and nid = nids_by_pos[pos]."""
+    from roc_tpu.ops.pallas.segment_sum import VB, build_chunk_plan
+    plan = build_chunk_plan(pos.astype(np.int64), keys_sorted.astype(np.int64),
+                            num_rows)
+    masked = plan.edst == VB
+    if nids_by_pos.shape[0] == 0:
+        nid = np.zeros_like(plan.esrc)
+    else:
+        nid = np.where(masked, 0,
+                       nids_by_pos[np.where(masked, 0, plan.esrc)])
+    return plan.obi, plan.edst, plan.esrc.astype(np.int32), \
+        nid.astype(np.int32)
+
+
+def build_gat_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
+                    num_rows: int, table_rows: int) -> GatPlans:
+    """Host-side schedule build.  ``edge_dst`` must be sorted ascending
+    (CSR order); ``edge_src`` indexes the source feature table (table-local
+    ids under a halo exchange)."""
+    edge_src = np.asarray(edge_src, np.int64)
+    edge_dst = np.asarray(edge_dst, np.int64)
+    E = edge_src.shape[0]
+    pos = np.arange(E, dtype=np.int64)
+    d = _position_plan(edge_dst, pos, edge_src, num_rows)
+    order = np.argsort(edge_src, kind="stable")
+    s = _position_plan(edge_src[order], order, edge_dst, table_rows)
+    return GatPlans(*(jnp.asarray(a) for a in d + s),
+                    num_rows=num_rows, table_rows=table_rows)
+
+
+# GatPlans rides jit argument pytrees: arrays are leaves, row counts static.
+jax.tree_util.register_pytree_node(
+    GatPlans,
+    lambda p: (p[:8], (p.num_rows, p.table_rows)),
+    lambda meta, arrs: GatPlans(*arrs, num_rows=meta[0], table_rows=meta[1]))
+
+
+def pad_gat_plans(plans: "list[GatPlans]", min_d: int = 0,
+                  min_s: int = 0) -> GatPlans:
+    """Stack per-shard GatPlans to common chunk counts (shard_map needs one
+    static program) — the attention analog of ops.aggregate.pad_plans.
+    Pad chunks: obi=last, edst=VB (all slots masked), pos/nid=0."""
+    from roc_tpu.ops.pallas.segment_sum import VB
+
+    def stack(prefix, floor):
+        quads = [(getattr(p, prefix + "obi"), getattr(p, prefix + "edst"),
+                  getattr(p, prefix + "pos"), getattr(p, prefix + "nid"))
+                 for p in plans]
+        C = max(max(q[0].shape[0] for q in quads), floor)
+        out = []
+        for obi, edst, posa, nid in quads:
+            pad = C - obi.shape[0]
+            if pad:
+                eb = edst.shape[1]
+                last = obi[-1] if obi.shape[0] else jnp.zeros((), obi.dtype)
+                obi = jnp.concatenate(
+                    [obi, jnp.broadcast_to(last, (pad,)).astype(obi.dtype)])
+                edst = jnp.concatenate(
+                    [edst, jnp.full((pad, eb), VB, edst.dtype)])
+                posa = jnp.concatenate(
+                    [posa, jnp.zeros((pad, eb), posa.dtype)])
+                nid = jnp.concatenate([nid, jnp.zeros((pad, eb), nid.dtype)])
+            out.append((obi, edst, posa, nid))
+        return [jnp.stack([o[i] for o in out]) for i in range(4)]
+
+    meta = {(p.num_rows, p.table_rows) for p in plans}
+    assert len(meta) == 1, f"shards disagree on plan geometry: {meta}"
+    d, s = stack("dst_", min_d), stack("src_", min_s)
+    return GatPlans(*(d + s), num_rows=plans[0].num_rows,
+                    table_rows=plans[0].table_rows)
+
+
+def _pad_steps(obi, edst, pos, nid, cb):
+    """Pad the chunk count to a multiple of ``cb`` with no-op chunks."""
+    C = obi.shape[0]
+    pad = -C % cb
+    if pad:
+        eb = edst.shape[1]
+        from roc_tpu.ops.pallas.segment_sum import VB
+        obi = jnp.concatenate(
+            [obi, jnp.broadcast_to(obi[-1], (pad,)).astype(obi.dtype)])
+        edst = jnp.concatenate([edst, jnp.full((pad, eb), VB, edst.dtype)])
+        pos = jnp.concatenate([pos, jnp.zeros((pad, eb), pos.dtype)])
+        nid = jnp.concatenate([nid, jnp.zeros((pad, eb), nid.dtype)])
+    return obi, edst, pos, nid, (C + pad) // cb
+
+
+def _plan_sum(edge_w, node_x, obi, edst, pos, nid, num_rows: int, precision):
+    """Segment-sum over plan windows of per-slot values
+    ``edge_w[pos] (⊗) node_x[nid]`` — the one-hot MXU machinery of
+    ops.aggregate._matmul_run generalized to edge-position plans.
+
+      edge_w: [E, K] or None;  node_x: [R2, K, F] or None (not both None).
+    Returns [num_rows, K] (node_x None) or [num_rows, K, F].
+    """
+    from roc_tpu.ops.aggregate import _one_hot_dots
+    from roc_tpu.ops.pallas.segment_sum import EB, VB
+    C = obi.shape[0]
+    cb = min(_PLAN_CB_SUM, max(8, C))
+    obi, edst, pos, nid, nsteps = _pad_steps(obi, edst, pos, nid, cb)
+    K = edge_w.shape[1] if edge_w is not None else node_x.shape[1]
+    F = node_x.shape[2] if node_x is not None else None
+    H = K if F is None else K * F
+    num_windows = (num_rows + VB - 1) // VB
+    acc_rows = (num_windows - 1 + cb) * VB
+
+    def body(acc, sl):
+        ob, ed, po, ni = sl
+        if node_x is not None:
+            g = jnp.take(node_x.reshape(node_x.shape[0], K * F),
+                         ni.reshape(cb * EB), axis=0, mode="clip")
+            if edge_w is not None:
+                w = jnp.take(edge_w, po.reshape(cb * EB), axis=0,
+                             mode="clip")
+                g = (g.reshape(-1, K, F) * w[:, :, None]).reshape(-1, H)
+        else:
+            g = jnp.take(edge_w, po.reshape(cb * EB), axis=0, mode="clip")
+        outs = _one_hot_dots(g, ed, ob, cb, precision)
+        base = ob[0] * VB
+        cur = jax.lax.dynamic_slice(acc, (base, 0), (cb * VB, H))
+        return jax.lax.dynamic_update_slice(acc, cur + outs, (base, 0)), None
+
+    ref = edge_w if edge_w is not None else node_x
+    acc = jnp.zeros((acc_rows, H), jnp.float32) \
+        + 0 * ref.reshape(-1)[0].astype(jnp.float32)
+    acc, _ = jax.lax.scan(
+        body, acc, (obi.reshape(nsteps, cb), edst.reshape(nsteps, cb, EB),
+                    pos.reshape(nsteps, cb, EB), nid.reshape(nsteps, cb, EB)))
+    out = acc[:num_rows].astype(ref.dtype)
+    return out if F is None else out.reshape(num_rows, K, F)
+
+
+def _plan_max(edge_w, obi, edst, pos, num_rows: int):
+    """Segment-max over plan windows of ``edge_w[pos]`` ([E, K] ->
+    [num_rows, K]).  Same window schedule as _plan_sum with masked maxima in
+    place of the one-hot dots; rows with no live slots return -inf."""
+    from roc_tpu.ops.pallas.segment_sum import EB, VB
+    C = obi.shape[0]
+    cb = min(_PLAN_CB_MAX, max(8, C))
+    obi, edst, pos, _, nsteps = _pad_steps(obi, edst, pos, pos, cb)
+    K = edge_w.shape[1]
+    num_windows = (num_rows + VB - 1) // VB
+    acc_rows = (num_windows - 1 + cb) * VB
+    neg = jnp.asarray(-jnp.inf, edge_w.dtype)
+
+    def body(acc, sl):
+        ob, ed, po = sl
+        s = jnp.take(edge_w, po.reshape(cb * EB), axis=0,
+                     mode="clip").reshape(cb, EB, K)
+        in_row = (jax.lax.broadcasted_iota(jnp.int32, (cb, VB, EB), 1)
+                  == ed[:, None, :])
+        within = jnp.max(jnp.where(in_row[..., None], s[:, None], neg),
+                         axis=2)                          # [cb, VB, K]
+        lw = ob - ob[0]
+        same_w = (jax.lax.broadcasted_iota(jnp.int32, (cb, cb), 0)
+                  == lw[None, :])                         # [w, chunk]
+        outs = jnp.max(jnp.where(same_w[:, :, None, None], within[None],
+                                 neg), axis=1)            # [cb, VB, K]
+        # acc is WINDOW-indexed ([W, VB, K]) — base is the window id itself,
+        # unlike the row-indexed accumulator of _plan_sum (ob[0] * VB)
+        cur = jax.lax.dynamic_slice(acc, (ob[0], 0, 0), (cb, VB, K))
+        return jax.lax.dynamic_update_slice(
+            acc, jnp.maximum(cur, outs), (ob[0], 0, 0)), None
+
+    acc = jnp.full((acc_rows // VB, VB, K), neg) + 0 * edge_w.reshape(-1)[0]
+    acc, _ = jax.lax.scan(
+        body, acc, (obi.reshape(nsteps, cb), edst.reshape(nsteps, cb, EB),
+                    pos.reshape(nsteps, cb, EB)))
+    return acc.reshape(acc_rows, K)[:num_rows]
+
+
+def _edge_contract(du, table, edge_src, edge_dst, dz):
+    """de[e, k] = Σ_f du[dst_e, k, f]·table[src_e, k, f] + dz[dst_e, k],
+    streamed over edge chunks so the [E, K, F] product never materializes."""
+    E, (K, F) = edge_src.shape[0], table.shape[1:]
+    chunk = max(_GAT_CHUNK_TARGET_ELEMS // max(K * F, 1), _GAT_CHUNK_MIN)
+    nchunks = -(-E // chunk)
+    pad = nchunks * chunk - E
+    src = jnp.pad(edge_src, (0, pad)).reshape(nchunks, chunk)
+    dst = jnp.pad(edge_dst, (0, pad)).reshape(nchunks, chunk)
+
+    def body(_, sl):
+        s_ids, d_ids = sl
+        duc = jnp.take(du, d_ids, axis=0)         # [chunk, K, F]
+        tc = jnp.take(table, s_ids, axis=0)
+        return None, (jnp.einsum("ckf,ckf->ck", duc, tc)
+                      + jnp.take(dz, d_ids, axis=0))
+    _, de = jax.lax.scan(body, None, (src, dst))
+    return de.reshape(nchunks * chunk, K)[:E]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(6,))
+def gat_attend_plan(h, table, a_src, a_dst, plans: GatPlans, edge_ids,
+                    slope: float):
+    """GAT attention over chunk plans — scatter-free fwd AND bwd.
+
+    Same semantics as :func:`gat_attend` (equal up to float reassociation:
+    different summation order).  ``edge_ids`` = (edge_src, edge_dst) [E]
+    arrays in dst-sorted order (table-local src ids under halo).  The
+    backward is hand-derived so no gather is ever transposed into a TPU
+    scatter; all reductions ride the dst-/src-keyed plans.
+    """
+    out, _ = _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope)
+    return out
+
+
+def _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope):
+    edge_src, edge_dst = edge_ids
+    N = plans.num_rows
+    K, F = h.shape[1], h.shape[2]
+    as_t = jnp.einsum("tkf,kf->tk", table, a_src)         # [T, K]
+    ad_l = jnp.einsum("nkf,kf->nk", h, a_dst)             # [N, K]
+    q = (jnp.take(ad_l, edge_dst, axis=0)
+         + jnp.take(as_t, edge_src, axis=0))              # [E, K]
+    s = jax.nn.leaky_relu(q, negative_slope=slope)
+    m = _plan_max(s, plans.dst_obi, plans.dst_edst, plans.dst_pos, N)
+    m = jax.lax.stop_gradient(jnp.where(jnp.isfinite(m), m, 0.0))
+    e = jnp.exp(s - jnp.take(m, edge_dst, axis=0))        # [E, K]
+    z = _plan_sum(e, None, plans.dst_obi, plans.dst_edst, plans.dst_pos,
+                  plans.dst_nid, N, "highest")            # [N, K]
+    u = _plan_sum(e, table, plans.dst_obi, plans.dst_edst, plans.dst_pos,
+                  plans.dst_nid, N, "highest")            # [N, K, F]
+    # Guard must be a NORMAL float: XLA flushes subnormals (1e-38) to zero,
+    # and rows with no in-edges (padded shard rows) have z == 0 → 0/0 NaN.
+    # Any live row has z >= 1 (the max edge contributes exp(0)).
+    zc = jnp.maximum(z, 1e-20)
+    out = u / zc[:, :, None]
+    return out, (h, table, a_src, a_dst, plans, edge_ids,
+                 q >= 0, e, zc, out)
+
+
+def _gat_plan_bwd(slope, res, gout):
+    h, table, a_src, a_dst, plans, edge_ids, qpos, e, zc, out = res
+    edge_src, edge_dst = edge_ids
+    N, T = plans.num_rows, plans.table_rows
+    K, F = h.shape[1], h.shape[2]
+    du = gout / zc[:, :, None]                            # [N, K, F]
+    dz = -jnp.einsum("nkf,nkf->nk", gout, out) / zc       # [N, K]
+    de = _edge_contract(du, table, edge_src, edge_dst, dz)
+    dq = e * de * jnp.where(qpos, 1.0, slope)             # [E, K]
+    dadl = _plan_sum(dq, None, plans.dst_obi, plans.dst_edst, plans.dst_pos,
+                     plans.dst_nid, N, "highest")         # [N, K]
+    dast = _plan_sum(dq, None, plans.src_obi, plans.src_edst, plans.src_pos,
+                     plans.src_nid, T, "highest")         # [T, K]
+    dtable = _plan_sum(e, du, plans.src_obi, plans.src_edst, plans.src_pos,
+                       plans.src_nid, T, "highest")       # [T, K, F]
+    dtable = dtable + dast[:, :, None] * a_src[None]
+    dh = dadl[:, :, None] * a_dst[None]
+    da_src = jnp.einsum("tk,tkf->kf", dast, table)
+    da_dst = jnp.einsum("nk,nkf->kf", dadl, h)
+    zeros = jax.tree.map(
+        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)
+        if jnp.issubdtype(a.dtype, jnp.integer) else jnp.zeros_like(a),
+        (plans, edge_ids))
+    return (dh, dtable, da_src, da_dst) + zeros
+
+
+gat_attend_plan.defvjp(_gat_plan_fwd, _gat_plan_bwd)

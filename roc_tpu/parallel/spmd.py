@@ -63,6 +63,7 @@ class ShardedGraphData:
     ring_src: Optional[jnp.ndarray] = None   # [P, P, Eo] int32, ring mode
     ring_dst: Optional[jnp.ndarray] = None   # [P, P, Eo] int32, ring mode
     plans: object = None             # stacked AggregatePlans ([P, ...] axes)
+    gat_plans: object = None         # stacked ops.edge.GatPlans
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
     mode: str = dataclasses.field(default="vertex",
                                   metadata={"static": True})
@@ -73,7 +74,7 @@ class ShardedGraphData:
 jax.tree_util.register_dataclass(
     ShardedGraphData,
     data_fields=["edge_src", "edge_dst", "in_degree", "send_idx",
-                 "ring_src", "ring_dst", "plans"],
+                 "ring_src", "ring_dst", "plans", "gat_plans"],
     meta_fields=["backend", "mode", "precision"])
 
 
@@ -230,23 +231,31 @@ def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
                 backend: str = "xla",
-                precision: str = "exact") -> ShardedGraphData:
+                precision: str = "exact",
+                gat_backend: str = "xla") -> ShardedGraphData:
     if halo is not None:
         src = halo.edge_src_local
     else:
         src = part.edge_src.astype(np.int32)
+    P_, S = part.num_parts, part.shard_nodes
+    table_rows = S + P_ * halo.K if halo is not None else P_ * S
     plans = None
     if backend in ("matmul", "binned"):
-        P_, S = part.num_parts, part.shard_nodes
-        table_rows = S + P_ * halo.K if halo is not None else P_ * S
         plans = _build_shard_plans(backend, src, part.edge_dst, S,
                                    table_rows)
+    gat_plans = None
+    if gat_backend == "plan":
+        from roc_tpu.ops.edge import build_gat_plans, pad_gat_plans
+        gat_plans = pad_gat_plans(
+            [build_gat_plans(src[i], part.edge_dst[i], S, table_rows)
+             for i in range(P_)])
     return ShardedGraphData(
         edge_src=jnp.asarray(src, jnp.int32),
         edge_dst=jnp.asarray(part.edge_dst, jnp.int32),
         in_degree=jnp.asarray(part.in_degree, jnp.float32),
         send_idx=None if halo is None else jnp.asarray(halo.send_idx),
         plans=plans,
+        gat_plans=gat_plans,
         backend=backend,
         precision=precision,
     )
@@ -394,6 +403,20 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
         kk, fd = h.shape[1], h.shape[2]
         table = _exchange(gd_block, exchange,
                           h.reshape(h.shape[0], kk * fd))
+        if gd_block.gat_plans is not None:
+            from roc_tpu.ops.edge import gat_attend_plan
+            # pvary: the attention params are replicated (unvarying) but
+            # the custom vjp's hand-written backward produces shard-local
+            # (device-varying) cotangents; ordinary ops get this exact
+            # promotion inserted implicitly (linear-layer weights), custom
+            # vjps must do it themselves or the vma typecheck rejects the
+            # bwd rule.  Grad semantics unchanged: per-shard partials,
+            # explicit psum in step_shard.
+            a_src_v = jax.lax.pvary(a_src, PARTS_AXIS)
+            a_dst_v = jax.lax.pvary(a_dst, PARTS_AXIS)
+            return gat_attend_plan(h, table.reshape(-1, kk, fd), a_src_v,
+                                   a_dst_v, gd_block.gat_plans,
+                                   (edge_src, edge_dst), slope)
         return ops.gat_attend(h, table.reshape(-1, kk, fd), edge_src,
                               edge_dst, shard_nodes, a_src, a_dst, slope)
 
@@ -445,7 +468,8 @@ class SpmdTrainer(BaseTrainer):
             "process-major")
         return ids
 
-    def _build_graph_full(self, backend: str) -> ShardedGraphData:
+    def _build_graph_full(self, backend: str,
+                          gat_backend: str = "xla") -> ShardedGraphData:
         """Single-host path: whole graph in memory, all P parts built."""
         cfg, ds = self.config, self.dataset
         assert self.part is not None, "_setup partitions before building"
@@ -496,7 +520,7 @@ class SpmdTrainer(BaseTrainer):
                     S_, table_rows, int(self.part.num_edges_valid.max())):
                 backend = "binned"
         return shard_graph(self.part, self.halo, backend,
-                           cfg.aggregate_precision)
+                           cfg.aggregate_precision, gat_backend=gat_backend)
 
     def _build_graph_perhost(self, backend: str) -> ShardedGraphData:
         """Pod-scale path: this process reads only its parts' `.lux` byte
@@ -635,8 +659,14 @@ class SpmdTrainer(BaseTrainer):
                       f"{cfg.aggregate_backend}; using xla", file=sys.stderr)
             backend = "xla"
 
+        # Plan-backend attention composes with halo/allgather vertex
+        # sharding (ring/edge modes raise for GAT; perhost keeps the
+        # chunked-scan fallback — its plan-count allgather is not wired).
+        gat_backend = self._gat_backend() \
+            if not (cfg.perhost_load or self._use_edge_shard
+                    or self._exchange_mode == "ring") else "xla"
         gd = self._build_graph_perhost(backend) if cfg.perhost_load \
-            else self._build_graph_full(backend)
+            else self._build_graph_full(backend, gat_backend)
         if cfg.verbose:
             self._log_shard_stats()
         S = self.part.shard_nodes

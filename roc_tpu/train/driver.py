@@ -39,6 +39,7 @@ class DenseGraphData:
     edge_dst: jnp.ndarray   # [E] int32, sorted
     in_degree: jnp.ndarray  # [N] float32
     plans: object = None    # ops.AggregatePlans for plan-based backends
+    gat_plans: object = None  # ops.edge.GatPlans for plan-backend attention
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
     precision: str = dataclasses.field(default="exact",
                                        metadata={"static": True})
@@ -46,7 +47,7 @@ class DenseGraphData:
 
 jax.tree_util.register_dataclass(
     DenseGraphData,
-    data_fields=["edge_src", "edge_dst", "in_degree", "plans"],
+    data_fields=["edge_src", "edge_dst", "in_degree", "plans", "gat_plans"],
     meta_fields=["backend", "precision"])
 
 
@@ -94,8 +95,20 @@ def resolve_backend(backend: str, num_edges: int, num_rows: int = 0,
     return backend
 
 
+def resolve_gat_backend(backend: str, num_edges: int) -> str:
+    """Attention backend: "plan" (one-hot chunk-plan softmax/aggregation,
+    ops.edge.gat_attend_plan — scatter-free fwd+bwd) or "xla" (dense /
+    chunked-scan gat_attend).  Same auto policy as the sum backends: plans
+    pay off exactly where TPU scatter would serialize."""
+    if backend == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        return "plan" if on_tpu and num_edges >= AUTO_MATMUL_EDGES else "xla"
+    return "xla" if backend == "xla" else "plan"
+
+
 def dense_graph_data(graph, backend: str = "xla",
-                     precision: str = "exact") -> DenseGraphData:
+                     precision: str = "exact",
+                     gat_backend: str = "xla") -> DenseGraphData:
     backend = resolve_backend(backend, graph.num_edges, graph.num_nodes,
                               graph.num_nodes)
     plans = None
@@ -105,11 +118,17 @@ def dense_graph_data(graph, backend: str = "xla",
     elif backend == "binned":
         plans = ops.build_binned_plans(
             graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
+    gat_plans = None
+    if gat_backend == "plan":
+        from roc_tpu.ops.edge import build_gat_plans
+        gat_plans = build_gat_plans(graph.col_idx, graph.dst_idx,
+                                    graph.num_nodes, graph.num_nodes)
     return DenseGraphData(
         edge_src=jnp.asarray(graph.col_idx, jnp.int32),
         edge_dst=jnp.asarray(graph.dst_idx, jnp.int32),
         in_degree=jnp.asarray(graph.in_degrees, jnp.float32),
         plans=plans,
+        gat_plans=gat_plans,
         backend=backend,
         precision=precision,
     )
@@ -135,6 +154,10 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
 
     def attend(h, a_src, a_dst, slope):
         # single device: the source table IS the local tensor
+        if g.gat_plans is not None:
+            from roc_tpu.ops.edge import gat_attend_plan
+            return gat_attend_plan(h, h, a_src, a_dst, g.gat_plans,
+                                   (g.edge_src, g.edge_dst), slope)
         return ops.gat_attend(h, h, g.edge_src, g.edge_dst, num_nodes,
                               a_src, a_dst, slope)
 
@@ -196,18 +219,30 @@ class BaseTrainer:
                                   g.num_nodes, g.num_nodes)
         aggrs = self._model_aggrs()
         if backend in ("binned", "matmul") and not ({"sum", "avg"} & aggrs):
-            if cfg.aggregate_backend != "auto":   # user explicitly chose it
+            if cfg.aggregate_backend != "auto" and not self._model_has_gat():
+                # (a GAT model honors the choice through the attention
+                # plan backend instead — _gat_backend)
                 print(f"# aggregate_backend={backend} only accelerates "
                       f"sum/avg aggregation; this model uses "
                       f"{sorted(aggrs)} — using xla")
             return "xla"
         return backend
 
+    def _gat_backend(self) -> str:
+        """Attention backend for models with gat ops ("plan" | "xla")."""
+        if not self._model_has_gat():
+            return "xla"
+        return resolve_gat_backend(self.config.aggregate_backend,
+                                   self.dataset.graph.num_edges)
+
     def _model_aggrs(self) -> set:
         """Aggregation kinds the built model actually uses (backend and
         edge-shard selection both key off this)."""
         return {op.attrs["aggr"] for op in self.model.ops
                 if op.kind == "aggregate"}
+
+    def _model_has_gat(self) -> bool:
+        return any(op.kind == "gat" for op in self.model.ops)
 
     def _run_step(self, step_key, alpha):
         self.params, self.opt_state, loss = self._train_step(
@@ -303,7 +338,8 @@ class Trainer(BaseTrainer):
         ds, model = self.dataset, self.model
         backend = self._effective_backend()
         self.gdata = dense_graph_data(ds.graph, backend,
-                                      self.config.aggregate_precision)
+                                      self.config.aggregate_precision,
+                                      gat_backend=self._gat_backend())
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.onehot_labels(), jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)

@@ -22,6 +22,11 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# The axon plugin registration (sitecustomize) sets jax_disable_bwd_checks
+# for its own backend quirks; that also disables the shard_map custom-vjp
+# vma typecheck and once let a bwd-rule bug pass CI while failing in every
+# clean environment.  Tests must run strict.
+jax.config.update("jax_disable_bwd_checks", False)
 try:
     from jax._src import xla_bridge
 

@@ -111,6 +111,118 @@ def test_chunked_gat_matches_dense(monkeypatch):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_gat_plan_matches_dense_and_grads():
+    """Plan-backend attention (ops.gat_attend_plan — scatter-free chunk-plan
+    softmax/aggregation) must match the dense oracle in value and in every
+    gradient (its backward is hand-derived, not autodiff)."""
+    for seed, n, K, F in [(3, 150, 3, 5), (7, 333, 1, 16), (11, 64, 4, 3)]:
+        ds = datasets.synthetic("t", n, 4.0, 8, 4, n_train=10, n_val=10,
+                                n_test=10, seed=seed)
+        g = ds.graph
+        N = g.num_nodes
+        rng = np.random.default_rng(seed)
+        h = jnp.asarray(rng.normal(size=(N, K, F)).astype(np.float32))
+        a_s = jnp.asarray(rng.normal(size=(K, F)).astype(np.float32))
+        a_d = jnp.asarray(rng.normal(size=(K, F)).astype(np.float32))
+        es, ed = jnp.asarray(g.col_idx), jnp.asarray(g.dst_idx)
+        plans = ops.build_gat_plans(g.col_idx, g.dst_idx, N, N)
+        ref = ops.gat_attend(h, h, es, ed, N, a_s, a_d, 0.2)
+        got = ops.gat_attend_plan(h, h, a_s, a_d, plans, (es, ed), 0.2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+        def loss_ref(h, a_s, a_d):
+            return jnp.sum(jnp.sin(
+                ops.gat_attend(h, h, es, ed, N, a_s, a_d, 0.2)))
+
+        def loss_plan(h, a_s, a_d):
+            return jnp.sum(jnp.sin(
+                ops.gat_attend_plan(h, h, a_s, a_d, plans, (es, ed), 0.2)))
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(h, a_s, a_d)
+        gp = jax.grad(loss_plan, argnums=(0, 1, 2))(h, a_s, a_d)
+        for a, b in zip(gr, gp):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-3, atol=1e-4)
+
+
+def test_gat_plan_multistep_scan_matches_oracle():
+    """A graph big enough that _plan_max/_plan_sum run MULTIPLE scan steps
+    (chunk count > the per-step block), with large-magnitude scores so a
+    wrong softmax max cannot hide behind shift-invariance.  Pins the
+    window-vs-row accumulator indexing (caught broken in review: every
+    step after the first wrote maxima to the wrong windows)."""
+    from roc_tpu.ops import edge as em
+    ds = datasets.synthetic("t", 2000, 20.0, 8, 4, n_train=10, n_val=10,
+                            n_test=10, seed=5)
+    g = ds.graph
+    N, K, F = g.num_nodes, 2, 4
+    plans = ops.build_gat_plans(g.col_idx, g.dst_idx, N, N)
+    assert plans.dst_obi.shape[0] > em._PLAN_CB_MAX, \
+        "graph too small to exercise the multi-step path"
+    rng = np.random.default_rng(5)
+    # 20x scale: exp(s - wrong_m) visibly diverges or overflows
+    h = jnp.asarray(20 * rng.normal(size=(N, K, F)).astype(np.float32))
+    a_s = jnp.asarray(rng.normal(size=(K, F)).astype(np.float32))
+    a_d = jnp.asarray(rng.normal(size=(K, F)).astype(np.float32))
+    es, ed = jnp.asarray(g.col_idx), jnp.asarray(g.dst_idx)
+    # _plan_max against the NumPy segment-max oracle
+    s = np.einsum("nkf,kf->nk", np.asarray(h), np.asarray(a_d))[g.dst_idx] \
+        + np.einsum("nkf,kf->nk", np.asarray(h), np.asarray(a_s))[g.col_idx]
+    s = np.where(s >= 0, s, 0.2 * s).astype(np.float32)
+    mo = np.full((N, K), -np.inf, np.float32)
+    np.maximum.at(mo, g.dst_idx, s)
+    m = np.asarray(em._plan_max(jnp.asarray(s), plans.dst_obi,
+                                plans.dst_edst, plans.dst_pos, N))
+    np.testing.assert_allclose(m, mo, rtol=1e-5, atol=1e-5)
+    # end-to-end against the dense oracle
+    ref = ops.gat_attend(h, h, es, ed, N, a_s, a_d, 0.2)
+    got = ops.gat_attend_plan(h, h, a_s, a_d, plans, (es, ed), 0.2)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_gat_plan_training_matches_xla():
+    """End-to-end GAT training with -aggr-backend matmul (which routes
+    attention through the plan backend) must track the xla-backend run."""
+    ds, g, _ = graph_and_x(n=200)
+    layers = [ds.in_dim, 8, ds.num_classes]
+
+    def run(backend):
+        cfg = Config(layers=layers, num_epochs=5, dropout_rate=0.0,
+                     learning_rate=0.01, weight_decay=0.0, eval_every=10**9,
+                     model="gat", heads=2, aggregate_backend=backend)
+        tr = Trainer(cfg, ds, build_gat(layers, 0.0, heads=2))
+        return [float(tr.run_epoch()) for _ in range(5)], tr
+
+    lx, _ = run("xla")
+    lm, tr = run("matmul")
+    assert tr.gdata.gat_plans is not None, "plan backend not engaged"
+    np.testing.assert_allclose(lm, lx, rtol=1e-3)
+
+
+def test_gat_plan_sharded_equals_single():
+    """Plan attention under halo vertex sharding: 4-part run must match the
+    single-device xla run epoch for epoch."""
+    ds, g, _ = graph_and_x(n=220)
+    layers = [ds.in_dim, 6, ds.num_classes]
+    cfg1 = Config(layers=layers, num_epochs=2, dropout_rate=0.0,
+                  eval_every=10**9)
+    cfgP = Config(layers=layers, num_epochs=2, dropout_rate=0.0,
+                  eval_every=10**9, num_parts=4, halo=True,
+                  aggregate_backend="matmul")
+    t1 = Trainer(cfg1, ds, build_gat(layers, 0.0, heads=2))
+    tp = SpmdTrainer(cfgP, ds, build_gat(layers, 0.0, heads=2))
+    assert tp.gdata.gat_plans is not None, "plan backend not engaged"
+    for i in range(2):
+        l1, lp = float(t1.run_epoch()), float(tp.run_epoch())
+        np.testing.assert_allclose(lp, l1, rtol=1e-4, err_msg=f"epoch {i}")
+    m1 = jax.device_get(t1.evaluate())
+    mp = jax.device_get(tp.evaluate())
+    assert int(m1.train_correct) == int(mp.train_correct)
+    assert int(m1.val_correct) == int(mp.val_correct)
+
+
 def test_gat_training_learns():
     ds, g, _ = graph_and_x(n=200)
     cfg = Config(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=30,
