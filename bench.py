@@ -233,7 +233,9 @@ def run():
                      weight_decay=1e-4, dropout_rate=0.5, eval_every=10**9,
                      num_parts=n_dev, halo=True, aggregate_backend=backend,
                      aggregate_precision=PRECISION, model=MODEL, heads=HEADS)
-        model = build_model(MODEL, LAYERS, cfg.dropout_rate, "sum",
+        # aggr="": each model's own default (gcn sum, sage avg, ...) so the
+        # metric name labels what actually ran
+        model = build_model(MODEL, LAYERS, cfg.dropout_rate, "",
                             heads=HEADS)
         if n_dev > 1:
             from roc_tpu.parallel.spmd import SpmdTrainer
@@ -261,11 +263,15 @@ def run():
         # as a healthy auto run.
         if BACKEND != "auto":
             raise
+        # GAT's attention backend maps both auto and matmul to the same
+        # "plan" path (resolve_gat_backend) — only xla is actually a
+        # different program there.
+        fb = "xla" if MODEL == "gat" else "matmul"
         print(f"# auto backend failed ({type(e).__name__}: "
-              f"{str(e)[:200]}); falling back to matmul", file=sys.stderr)
+              f"{str(e)[:200]}); falling back to {fb}", file=sys.stderr)
         fallback_from = type(e).__name__
     if fallback_from is not None:   # outside except: drop the failed
-        trainer = build_and_warm("matmul")   # trainer's HBM before rebuild
+        trainer = build_and_warm(fb)         # trainer's HBM before rebuild
     t1 = time.perf_counter()
     for _ in range(MEASURED):
         loss = trainer.run_epoch()
@@ -288,7 +294,7 @@ def run():
         "platform": jax.default_backend(),
     }
     if fallback_from is not None:
-        result["fallback"] = f"auto failed ({fallback_from}); ran matmul"
+        result["fallback"] = f"auto failed ({fallback_from}); ran {fb}"
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and fallback_from is None and resolved == "binned"):

@@ -208,6 +208,13 @@ def _position_plan(keys_sorted, pos, nids_by_pos, num_rows):
     from roc_tpu.ops.pallas.segment_sum import VB, build_chunk_plan
     plan = build_chunk_plan(pos.astype(np.int64), keys_sorted.astype(np.int64),
                             num_rows)
+    # Same invariant build_aggregate_plans pins: every window gets >= 1
+    # chunk (consecutive obi jump <= 1), or _one_hot_dots/_plan_max would
+    # silently drop windows (lw >= cb).  The native C++ builder serves
+    # plans >= 1M edges — exactly the production attention regime — so the
+    # check must live here, where both builders pass through.
+    assert np.all(np.diff(np.asarray(plan.obi)) <= 1), \
+        "chunk plan skips output windows (obi jump > 1)"
     masked = plan.edst == VB
     if nids_by_pos.shape[0] == 0:
         nid = np.zeros_like(plan.esrc)
@@ -241,32 +248,28 @@ jax.tree_util.register_pytree_node(
     lambda meta, arrs: GatPlans(*arrs, num_rows=meta[0], table_rows=meta[1]))
 
 
+def _pad_posplan(obi, edst, pos, nid, pad: int):
+    """No-op pad chunks for an edge-position plan, routed through
+    segment_sum.pad_chunks (the single owner of the pad recipe) — pos and
+    nid both take esrc's treatment (zeros; every slot masked via edst=VB)."""
+    from roc_tpu.ops.pallas.segment_sum import pad_chunks
+    first0 = jnp.zeros_like(obi)
+    obi2, _, edst2, pos2 = pad_chunks(obi, first0, edst, pos, pad, jnp)
+    *_, nid2 = pad_chunks(obi, first0, edst, nid, pad, jnp)
+    return obi2, edst2, pos2, nid2
+
+
 def pad_gat_plans(plans: "list[GatPlans]", min_d: int = 0,
                   min_s: int = 0) -> GatPlans:
     """Stack per-shard GatPlans to common chunk counts (shard_map needs one
-    static program) — the attention analog of ops.aggregate.pad_plans.
-    Pad chunks: obi=last, edst=VB (all slots masked), pos/nid=0."""
-    from roc_tpu.ops.pallas.segment_sum import VB
+    static program) — the attention analog of ops.aggregate.pad_plans."""
 
     def stack(prefix, floor):
         quads = [(getattr(p, prefix + "obi"), getattr(p, prefix + "edst"),
                   getattr(p, prefix + "pos"), getattr(p, prefix + "nid"))
                  for p in plans]
         C = max(max(q[0].shape[0] for q in quads), floor)
-        out = []
-        for obi, edst, posa, nid in quads:
-            pad = C - obi.shape[0]
-            if pad:
-                eb = edst.shape[1]
-                last = obi[-1] if obi.shape[0] else jnp.zeros((), obi.dtype)
-                obi = jnp.concatenate(
-                    [obi, jnp.broadcast_to(last, (pad,)).astype(obi.dtype)])
-                edst = jnp.concatenate(
-                    [edst, jnp.full((pad, eb), VB, edst.dtype)])
-                posa = jnp.concatenate(
-                    [posa, jnp.zeros((pad, eb), posa.dtype)])
-                nid = jnp.concatenate([nid, jnp.zeros((pad, eb), nid.dtype)])
-            out.append((obi, edst, posa, nid))
+        out = [_pad_posplan(*q, C - q[0].shape[0]) for q in quads]
         return [jnp.stack([o[i] for o in out]) for i in range(4)]
 
     meta = {(p.num_rows, p.table_rows) for p in plans}
@@ -280,14 +283,7 @@ def _pad_steps(obi, edst, pos, nid, cb):
     """Pad the chunk count to a multiple of ``cb`` with no-op chunks."""
     C = obi.shape[0]
     pad = -C % cb
-    if pad:
-        eb = edst.shape[1]
-        from roc_tpu.ops.pallas.segment_sum import VB
-        obi = jnp.concatenate(
-            [obi, jnp.broadcast_to(obi[-1], (pad,)).astype(obi.dtype)])
-        edst = jnp.concatenate([edst, jnp.full((pad, eb), VB, edst.dtype)])
-        pos = jnp.concatenate([pos, jnp.zeros((pad, eb), pos.dtype)])
-        nid = jnp.concatenate([nid, jnp.zeros((pad, eb), nid.dtype)])
+    obi, edst, pos, nid = _pad_posplan(obi, edst, pos, nid, pad)
     return obi, edst, pos, nid, (C + pad) // cb
 
 

@@ -64,6 +64,7 @@ class ShardedGraphData:
     ring_dst: Optional[jnp.ndarray] = None   # [P, P, Eo] int32, ring mode
     plans: object = None             # stacked AggregatePlans ([P, ...] axes)
     gat_plans: object = None         # stacked ops.edge.GatPlans
+    ring_plans: object = None        # ring.RingPlans ([P, P, ...] axes)
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
     mode: str = dataclasses.field(default="vertex",
                                   metadata={"static": True})
@@ -74,7 +75,7 @@ class ShardedGraphData:
 jax.tree_util.register_dataclass(
     ShardedGraphData,
     data_fields=["edge_src", "edge_dst", "in_degree", "send_idx",
-                 "ring_src", "ring_dst", "plans", "gat_plans"],
+                 "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans"],
     meta_fields=["backend", "mode", "precision"])
 
 
@@ -198,6 +199,130 @@ def _ea_bwd(precision, plans, g):
 edge_aggregate_matmul.defvjp(_ea_fwd, _ea_bwd)
 
 
+@dataclasses.dataclass(frozen=True)
+class EdgeBinnedPlans:
+    """Binned two-phase schedules for edge-sharded aggregation — the
+    composition VERDICT r2 flagged missing: each block's contiguous
+    scatter window (the same windowing EdgePlans proves out for matmul)
+    becomes the binned kernel's output space, so the fastest kernel runs
+    under the skew-proof distribution mode.  ``plans.fwd/bwd`` are stacked
+    :class:`roc_tpu.ops.aggregate.BinnedPlans` payloads ([P, ...] axes);
+    bases place each block's [span, H] result in the global accumulator."""
+    plans: object             # ops.BinnedPlans (stacked fwd+bwd payloads)
+    fwd_base: jnp.ndarray     # [P] int32
+    bwd_base: jnp.ndarray     # [P] int32
+
+
+jax.tree_util.register_dataclass(
+    EdgeBinnedPlans, data_fields=["plans", "fwd_base", "bwd_base"],
+    meta_fields=[])
+
+
+def build_edge_binned_plans(graph, meta, fwd_arrays=None):
+    """Per-block binned plans over the blocks' scatter windows, or None
+    where the binned occupancy model says the padding would eat the win
+    (caller falls back to the matmul windowed plans)."""
+    from roc_tpu.ops.pallas.binned import binned_viable
+    NS = meta.num_parts * meta.shard_nodes
+    f_gat, f_sct = fwd_arrays if fwd_arrays is not None \
+        else edge_block_arrays(graph, meta)
+    b_gat, b_sct = edge_block_arrays_t(graph, meta)
+    P_, Eb = f_sct.shape
+    from roc_tpu.ops.pallas.segment_sum import VB
+
+    from roc_tpu.ops.pallas.binned import build_binned_plan
+
+    def direction(gather, scatter):
+        bases = (scatter.min(axis=1) // VB) * VB
+        span = int((scatter.max(axis=1) + 1 - bases).max())
+        span = min(-(-span // VB) * VB, NS)
+        bases = np.minimum(bases, NS - span)
+        if not binned_viable(span, NS, Eb):
+            return None
+        return [build_binned_plan(
+            np.asarray(gather[p], np.int64),
+            np.asarray(scatter[p] - bases[p], np.int64), span, NS)
+            for p in range(P_)], bases
+
+    f = direction(f_gat, f_sct)
+    b = direction(b_gat, b_sct)
+    if f is None or b is None:
+        return None
+    fwd_list, f_bases = f
+    bwd_list, b_bases = b
+    stacked = ops.pad_binned_plans(
+        [ops.BinnedPlans(fwd=fw, bwd=bw)
+         for fw, bw in zip(fwd_list, bwd_list)])
+    return EdgeBinnedPlans(plans=stacked,
+                           fwd_base=jnp.asarray(f_bases, jnp.int32),
+                           bwd_base=jnp.asarray(b_bases, jnp.int32))
+
+
+def _eb_half(x, plan, base, interpret):
+    """One direction of binned edge-mode aggregation: all-gather the
+    source table, binned sum over this block's window, place at the
+    block's base, reduce onto owners (same shape as _edge_mm_half)."""
+    from roc_tpu.ops.pallas.binned import run_binned
+    table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)    # [NS, H]
+    NS, H = table.shape
+    part_loc = run_binned(table, plan, interpret)            # [span, H]
+    acc = jnp.zeros((NS, H), part_loc.dtype) + 0 * part_loc[:1, :1]
+    acc = jax.lax.dynamic_update_slice(acc, part_loc, (base, 0))
+    return jax.lax.psum_scatter(acc, PARTS_AXIS, scatter_dimension=0,
+                                tiled=True)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def edge_aggregate_binned(x, eplans: EdgeBinnedPlans, interpret):
+    """Edge-sharded sum aggregation on the binned backend (inside
+    shard_map; plan payloads are this shard's block).  Backward = the
+    same kernel over the transposed (src-sorted) block windows."""
+    return _eb_half(x, eplans.plans.fwd, eplans.fwd_base, interpret)
+
+
+def _eb_fwd(x, eplans, interpret):
+    return edge_aggregate_binned(x, eplans, interpret), eplans
+
+
+def _eb_bwd(interpret, eplans, g):
+    dx = _eb_half(g, eplans.plans.bwd, eplans.bwd_base, interpret)
+    zero = jax.tree.map(
+        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), eplans)
+    return dx, zero
+
+
+edge_aggregate_binned.defvjp(_eb_fwd, _eb_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_owner_matmul(buf, fwd, bwd, S: int, precision):
+    """One ring step's owner-group aggregation on the matmul plan backend:
+    out[d] = Σ buf[src] over the visiting owner's edge group, scatter-free.
+    ``fwd``/``bwd`` are this owner's (obi, edst, esrc) plan slices (the
+    bwd is the src-sorted transpose).  AD of the gather would emit the
+    serialized TPU scatter the plan backends exist to avoid."""
+    from roc_tpu.ops.aggregate import _matmul_run
+    return _matmul_run(buf, *fwd, S + 1, precision)[:S]  # row S: pad drop
+
+
+def _rom_fwd(buf, fwd, bwd, S, precision):
+    return ring_owner_matmul(buf, fwd, bwd, S, precision), (fwd, bwd)
+
+
+def _rom_bwd(S, precision, res, g):
+    fwd, bwd = res
+    from roc_tpu.ops.aggregate import _matmul_run
+    # zero row at S: pad slots (dst sentinel) gather exact zeros
+    gpad = jnp.concatenate([g, jnp.zeros_like(g[:1])], axis=0)
+    dbuf = _matmul_run(gpad, *bwd, S, precision)
+    f0 = lambda arrs: tuple(np.zeros(a.shape, dtype=jax.dtypes.float0)  # noqa: E731
+                            for a in arrs)
+    return dbuf, f0(fwd), f0(bwd)
+
+
+ring_owner_matmul.defvjp(_rom_fwd, _rom_bwd)
+
+
 def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
                        allgather=None):
     """Per-shard aggregation plans, stacked to one static program.  Under
@@ -286,9 +411,24 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
     base = "sum" if aggr in ("sum", "avg") else aggr
     perm = [(i, (i + 1) % P_) for i in range(P_)]
 
+    rp = gd_block.ring_plans
+
     def step(carry, k):
         buf, acc = carry
         owner = jax.lax.rem(p - k + P_, P_)       # whose rows buf holds
+        if rp is not None and base == "sum":
+            # plan fast path: the owner's group aggregation is one-hot
+            # matmuls over its prebuilt chunk plan (fwd AND bwd)
+            fwd = tuple(jnp.take(a, owner, axis=0)
+                        for a in (rp.fwd_obi, rp.fwd_edst, rp.fwd_esrc))
+            bwd = tuple(jnp.take(a, owner, axis=0)
+                        for a in (rp.bwd_obi, rp.bwd_edst, rp.bwd_esrc))
+            part = ring_owner_matmul(
+                buf, fwd, bwd, S,
+                ops.matmul_precision(gd_block.precision))
+            acc = acc + part
+            buf = jax.lax.ppermute(buf, PARTS_AXIS, perm)
+            return (buf, acc), None
         es = jnp.take(gd_block.ring_src, owner, axis=0)       # [Eo]
         ed = jnp.take(gd_block.ring_dst, owner, axis=0)       # [Eo], pad=S
         gathered = jnp.take(buf, es, axis=0)
@@ -347,7 +487,9 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
                 raise ValueError(
                     f"edge-sharded aggregation supports sum/avg, not {aggr}"
                     " (use vertex sharding for max/min models)")
-            if gd_block.plans is not None:      # matmul backend: scatter-free
+            if gd_block.backend == "binned" and gd_block.plans is not None:
+                out = edge_aggregate_binned(x, gd_block.plans, interp)
+            elif gd_block.plans is not None:    # matmul backend: scatter-free
                 out = edge_aggregate_matmul(
                     x, gd_block.plans,
                     ops.matmul_precision(gd_block.precision))
@@ -412,8 +554,8 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
             # vjps must do it themselves or the vma typecheck rejects the
             # bwd rule.  Grad semantics unchanged: per-shard partials,
             # explicit psum in step_shard.
-            a_src_v = jax.lax.pvary(a_src, PARTS_AXIS)
-            a_dst_v = jax.lax.pvary(a_dst, PARTS_AXIS)
+            a_src_v = jax.lax.pcast(a_src, PARTS_AXIS, to="varying")
+            a_dst_v = jax.lax.pcast(a_dst, PARTS_AXIS, to="varying")
             return gat_attend_plan(h, table.reshape(-1, kk, fd), a_src_v,
                                    a_dst_v, gd_block.gat_plans,
                                    (edge_src, edge_dst), slope)
@@ -478,6 +620,15 @@ class SpmdTrainer(BaseTrainer):
             eb_src, eb_dst = edge_block_arrays(ds.graph, self.part.meta)
             assert self.part.num_parts * self.part.shard_nodes < 2**31
             plans = None
+            if backend == "binned":
+                plans = build_edge_binned_plans(
+                    ds.graph, self.part.meta, fwd_arrays=(eb_src, eb_dst))
+                if plans is None:
+                    if jax.process_index() == 0:
+                        print("# -edge-shard binned: block windows fail "
+                              "the occupancy bound; using matmul",
+                              file=sys.stderr)
+                    backend = "matmul"
             if backend == "matmul":
                 # Windowed one-hot plans per block (TPU would otherwise
                 # serialize each block's scatter); backward rides the
@@ -492,9 +643,14 @@ class SpmdTrainer(BaseTrainer):
                 send_idx=None, plans=plans, backend=backend, mode="edge",
                 precision=cfg.aggregate_precision)
         if self._exchange_mode == "ring":
-            from roc_tpu.parallel.ring import build_ring_groups
+            from roc_tpu.parallel.ring import build_ring_groups, \
+                build_ring_plans
             self.halo = None
             rm = build_ring_groups(self.part)
+            ring_plans = None
+            if backend == "matmul":
+                rp = build_ring_plans(rm, self.part.shard_nodes)
+                ring_plans = jax.tree.map(jnp.asarray, rp)
             return ShardedGraphData(
                 edge_src=jnp.asarray(self.part.edge_src, jnp.int32),
                 edge_dst=jnp.asarray(self.part.edge_dst, jnp.int32),
@@ -502,7 +658,8 @@ class SpmdTrainer(BaseTrainer):
                 send_idx=None,
                 ring_src=jnp.asarray(rm.ring_src),
                 ring_dst=jnp.asarray(rm.ring_dst),
-                plans=None, backend=backend, mode="ring")
+                plans=None, ring_plans=ring_plans, backend=backend,
+                mode="ring", precision=cfg.aggregate_precision)
         self.halo = build_halo_maps(self.part) \
             if self._exchange_mode == "halo" else None
         if backend == "matmul" and cfg.aggregate_backend == "auto":
@@ -650,14 +807,17 @@ class SpmdTrainer(BaseTrainer):
                       file=sys.stderr)
             self._exchange_mode = "halo"   # ignored by the edge path
         backend = self._effective_backend()
-        if self._exchange_mode == "ring" and backend != "xla":
-            # ring aggregates incrementally per visiting shard — the
-            # plan backends need one materialized source table
-            if cfg.aggregate_backend not in ("auto", "xla") and \
+        if self._exchange_mode == "ring" and backend == "binned":
+            # ring aggregates per visiting owner group over prebuilt chunk
+            # plans (ring_owner_matmul); the binned kernels' bin schedule
+            # doesn't apply to the rotating buffer — matmul is the ring
+            # fast path.
+            if cfg.aggregate_backend not in ("auto",) and \
                     jax.process_index() == 0:
-                print(f"# -exchange ring ignores aggregate_backend="
-                      f"{cfg.aggregate_backend}; using xla", file=sys.stderr)
-            backend = "xla"
+                print(f"# -exchange ring: aggregate_backend="
+                      f"{cfg.aggregate_backend} rides the matmul ring "
+                      f"plans", file=sys.stderr)
+            backend = "matmul"
 
         # Plan-backend attention composes with halo/allgather vertex
         # sharding (ring/edge modes raise for GAT; perhost keeps the

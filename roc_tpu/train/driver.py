@@ -197,23 +197,21 @@ class BaseTrainer:
         cfg = self.config
         g = self.dataset.graph
         if self._use_edge_shard:
-            # Edge-sharded aggregation supports xla and matmul (windowed
-            # per-block one-hot plans, spmd.edge_aggregate_matmul); the
-            # binned kernels' (block x bin) schedule does not apply there.
+            # Edge-sharded aggregation supports xla, matmul (windowed
+            # per-block one-hot plans, spmd.edge_aggregate_matmul) and,
+            # where the block-window occupancy model holds, binned
+            # (spmd.edge_aggregate_binned; falls back to matmul in
+            # _build_graph_full otherwise).  auto resolves to matmul — the
+            # binned viability bound needs the block spans, known only
+            # after the edge blocks are built.
             backend = resolve_backend(cfg.aggregate_backend, g.num_edges)
-            if backend == "binned":
-                backend = "matmul"
-            if backend == "matmul" \
+            if backend in ("matmul", "binned") \
                     and not ({"sum", "avg"} & self._model_aggrs()):
                 if cfg.aggregate_backend != "auto":
                     print(f"# aggregate_backend={cfg.aggregate_backend} "
                           f"only accelerates sum/avg aggregation under "
                           f"-edge-shard; using xla")
                 return "xla"
-            if backend == "matmul" and cfg.aggregate_backend in (
-                    "binned", "pallas"):
-                print("# -edge-shard supports xla|matmul aggregation; "
-                      "using matmul")
             return backend
         backend = resolve_backend(cfg.aggregate_backend, g.num_edges,
                                   g.num_nodes, g.num_nodes)

@@ -164,6 +164,53 @@ def test_ring_exchange_matches_halo_and_single_device():
         np.testing.assert_allclose(lr, l1, rtol=rtol, err_msg=f"epoch {i}")
 
 
+def test_ring_exchange_matmul_plans_match_xla():
+    """-exchange ring -aggr-backend matmul (per-owner chunk plans,
+    ring_owner_matmul — the ring fast path VERDICT r2 flagged missing)
+    must track the xla ring and single-device runs, and avg must ride the
+    same plans."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn, build_sage
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("ringmm", 260, 4.0, 8, 4, n_train=50, n_val=50,
+                            n_test=50, seed=6)
+    layers = [8, 8, 4]
+    base = dict(layers=layers, num_epochs=3, dropout_rate=0.0,
+                eval_every=10 ** 9, edge_shard="off")
+    t1 = Trainer(Config(**base), ds, build_gcn(layers, 0.0))
+    tx = SpmdTrainer(Config(**base, num_parts=4, exchange="ring"), ds,
+                     build_gcn(layers, 0.0))
+    tm = SpmdTrainer(Config(**base, num_parts=4, exchange="ring",
+                            aggregate_backend="matmul"), ds,
+                     build_gcn(layers, 0.0))
+    assert tm.gdata.backend == "matmul"
+    assert tm.gdata.ring_plans is not None, "ring plans not engaged"
+    for i, rtol in enumerate((2e-5, 5e-3, 5e-3)):
+        l1 = float(t1.run_epoch())
+        lx = float(tx.run_epoch())
+        lm = float(tm.run_epoch())
+        np.testing.assert_allclose(lm, lx, rtol=rtol, err_msg=f"epoch {i}")
+        np.testing.assert_allclose(lm, l1, rtol=rtol, err_msg=f"epoch {i}")
+
+    # avg on the plan path (sage-mean): sum plans / in-degree
+    ds2 = datasets.synthetic("ringmma", 220, 4.0, 8, 4, n_train=40,
+                             n_val=40, n_test=40, seed=7)
+    base2 = dict(layers=layers, num_epochs=2, dropout_rate=0.0,
+                 eval_every=10 ** 9, edge_shard="off", aggr="avg",
+                 model="sage")
+    t1a = Trainer(Config(**base2), ds2, build_sage(layers, 0.0, aggr="avg"))
+    tma = SpmdTrainer(Config(**base2, num_parts=4, exchange="ring",
+                             aggregate_backend="matmul"), ds2,
+                      build_sage(layers, 0.0, aggr="avg"))
+    assert tma.gdata.ring_plans is not None
+    for i, rtol in enumerate((2e-5, 5e-3)):
+        l1, lm = float(t1a.run_epoch()), float(tma.run_epoch())
+        np.testing.assert_allclose(lm, l1, rtol=rtol, err_msg=f"epoch {i}")
+
+
 def test_ring_exchange_sage_avg_and_max():
     """Ring mode supports avg (sum/degree) and max (max-of-maxes across
     visiting shards)."""
