@@ -16,14 +16,20 @@ PARTS_AXIS = "parts"
 
 
 def make_mesh(num_parts: int, devices=None) -> jax.sharding.Mesh:
-    """1-D mesh with `num_parts` devices along the 'parts' axis.
+    """1-D mesh along the 'parts' axis.
 
-    num_parts must equal the device count used (the reference's
-    parts-per-GPU overcommit trick, gnn.cc:61-63, is reproduced in tests
-    via XLA's virtual host devices instead of task multiplexing).
+    ``num_parts <= devices``: one part per device (mesh over the first
+    num_parts devices).  ``num_parts > devices``: the reference's
+    parts-per-GPU overcommit (gnn.cc:61-63 multiplexes numParts point tasks
+    onto fewer GPUs) — the mesh spans every device and each one stacks
+    ``k = num_parts / devices`` shard blocks inside the shard_map body
+    (num_parts must divide evenly).  This is what lets a single bench chip
+    run multi-part code paths for real.
     """
     devices = list(jax.devices() if devices is None else devices)
-    assert num_parts <= len(devices), (
-        f"num_parts={num_parts} exceeds available devices={len(devices)}; "
-        "for local testing raise --xla_force_host_platform_device_count")
-    return jax.sharding.Mesh(devices[:num_parts], (PARTS_AXIS,))
+    if num_parts <= len(devices):
+        return jax.sharding.Mesh(devices[:num_parts], (PARTS_AXIS,))
+    assert num_parts % len(devices) == 0, (
+        f"num_parts={num_parts} must be a multiple of the device count "
+        f"({len(devices)}) for parts-per-device overcommit")
+    return jax.sharding.Mesh(devices, (PARTS_AXIS,))

@@ -524,46 +524,123 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
                         in_degree=gd_block.in_degree, attend=attend_ring)
 
     def aggregate(x, aggr):
-        table = _exchange(gd_block, exchange, x)
         # avg rides the sum fast path: per-shard in_degree is the live
         # in-edge count (pad rows carry 1, and their sums are zero anyway).
-        if gd_block.plans is not None and aggr in ("sum", "avg"):
-            if gd_block.backend == "binned":
-                out = ops.scatter_gather_binned(table, gd_block.plans,
-                                                interp)
-            else:
-                out = ops.scatter_gather_matmul(
-                    table, gd_block.plans, shard_nodes, table.shape[0],
-                    ops.matmul_precision(gd_block.precision))
-            if aggr == "avg":
-                out = ops.divide_by_degree(out, gd_block.in_degree)
-            return out
-        return ops.scatter_gather(table, edge_src, edge_dst, shard_nodes,
-                                  aggr)
+        table = _exchange(gd_block, exchange, x)
+        return _vertex_aggregate(table, gd_block, shard_nodes, aggr, interp)
 
     def attend(h, a_src, a_dst, slope):
         kk, fd = h.shape[1], h.shape[2]
         table = _exchange(gd_block, exchange,
                           h.reshape(h.shape[0], kk * fd))
-        if gd_block.gat_plans is not None:
-            from roc_tpu.ops.edge import gat_attend_plan
-            # pvary: the attention params are replicated (unvarying) but
-            # the custom vjp's hand-written backward produces shard-local
-            # (device-varying) cotangents; ordinary ops get this exact
-            # promotion inserted implicitly (linear-layer weights), custom
-            # vjps must do it themselves or the vma typecheck rejects the
-            # bwd rule.  Grad semantics unchanged: per-shard partials,
-            # explicit psum in step_shard.
-            a_src_v = jax.lax.pcast(a_src, PARTS_AXIS, to="varying")
-            a_dst_v = jax.lax.pcast(a_dst, PARTS_AXIS, to="varying")
-            return gat_attend_plan(h, table.reshape(-1, kk, fd), a_src_v,
-                                   a_dst_v, gd_block.gat_plans,
-                                   (edge_src, edge_dst), slope)
-        return ops.gat_attend(h, table.reshape(-1, kk, fd), edge_src,
-                              edge_dst, shard_nodes, a_src, a_dst, slope)
+        return _vertex_attend(table, gd_block, shard_nodes, h, a_src,
+                              a_dst, slope)
 
     return GraphCtx(aggregate=aggregate, in_degree=gd_block.in_degree,
                     attend=attend)
+
+
+def _part_view(tree_, j: int):
+    """Select local part ``j`` from a [k, ...]-stacked per-device block."""
+    return jax.tree.map(lambda a: a[j], tree_)
+
+
+def _vertex_aggregate(table, gdj, S: int, aggr: str, interp: bool):
+    """One part's vertex-mode aggregation over its source table — the
+    single backend dispatch shared by _shard_gctx (k=1) and
+    _shard_gctx_over (k parts stacked per device)."""
+    if gdj.plans is not None and aggr in ("sum", "avg"):
+        if gdj.backend == "binned":
+            out = ops.scatter_gather_binned(table, gdj.plans, interp)
+        else:
+            out = ops.scatter_gather_matmul(
+                table, gdj.plans, S, table.shape[0],
+                ops.matmul_precision(gdj.precision))
+        if aggr == "avg":
+            out = ops.divide_by_degree(out, gdj.in_degree)
+        return out
+    return ops.scatter_gather(table, gdj.edge_src, gdj.edge_dst, S, aggr)
+
+
+def _vertex_attend(table_flat, gdj, S: int, h_local, a_src, a_dst, slope):
+    """One part's GAT attention (plan backend when built, else dense/
+    chunked) — shared by both vertex gctx builders.  ``table_flat`` is the
+    exchanged [T, K*F] source table for this part."""
+    kk, fd = h_local.shape[1], h_local.shape[2]
+    tab = table_flat.reshape(-1, kk, fd)
+    if gdj.gat_plans is not None:
+        from roc_tpu.ops.edge import gat_attend_plan
+        # pcast: the attention params are replicated (unvarying) but the
+        # custom vjp's hand-written backward produces shard-local
+        # (device-varying) cotangents; ordinary ops get this promotion
+        # implicitly (linear-layer weights), custom vjps must do it
+        # themselves or the vma typecheck rejects the bwd rule.  Grad
+        # semantics unchanged: per-shard partials, explicit psum upstream.
+        av = jax.lax.pcast(a_src, PARTS_AXIS, to="varying")
+        dv = jax.lax.pcast(a_dst, PARTS_AXIS, to="varying")
+        return gat_attend_plan(h_local, tab, av, dv, gdj.gat_plans,
+                               (gdj.edge_src, gdj.edge_dst), slope)
+    return ops.gat_attend(h_local, tab, gdj.edge_src, gdj.edge_dst, S,
+                          a_src, a_dst, slope)
+
+
+def _overcommit_tables(gd_block, k: int, S: int, exchange: str, x):
+    """Per-local-part source tables when k parts share one device (the
+    reference's parts>GPUs overcommit, gnn.cc:61-63).  ``x`` is [k*S, H]
+    (this device's k shards stacked in part order).
+
+    halo: ONE all_to_all moves every (sender part i, receiver part j) halo
+    block between devices; receiver part j's table is its own S rows ++
+    the [P*K] halo rows reassembled in global part order — exactly the
+    layout edge_src_local/plans were built against, so the per-part
+    aggregation code is unchanged.  allgather: one table serves all k
+    parts (padded-global ids index [P*S] in device-major == part order)."""
+    H = x.shape[-1]
+    if exchange != "halo":
+        table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)   # [P*S, H]
+        return [table] * k
+    sidx = gd_block.send_idx                 # [k_i, P, K] (i = sender)
+    k_, P_, K = sidx.shape
+    D = P_ // k
+    # [D_to, k_i(sender here), k_j(receiver there), K] with stacked-row
+    # offsets: send_idx values are local to sender part i
+    idx = sidx.reshape(k, D, k, K).transpose(1, 0, 2, 3) \
+        + (jnp.arange(k, dtype=sidx.dtype) * S)[None, :, None, None]
+    send = jnp.take(x, idx.reshape(D, k * k * K), axis=0)
+    recv = jax.lax.all_to_all(send, PARTS_AXIS, split_axis=0, concat_axis=0)
+    recv = recv.reshape(D, k, k, K, H)       # [from-dev, from-part, j, K, H]
+    tables = []
+    for j in range(k):
+        halo = recv[:, :, j].reshape(P_ * K, H)   # global part order
+        tables.append(jnp.concatenate([x[j * S:(j + 1) * S], halo], axis=0))
+    return tables
+
+
+def _shard_gctx_over(gd_block, S: int, k: int, exchange: str) -> GraphCtx:
+    """Overcommit (k>1) counterpart of :func:`_shard_gctx`: one exchange
+    for the device's stacked block, then the standard per-part aggregation
+    over each part's own plan/edge slice, concatenated back."""
+    from roc_tpu.train.driver import pallas_interpret
+    interp = pallas_interpret()
+    assert gd_block.mode == "vertex", "overcommit is vertex-mode only"
+
+    def aggregate(x, aggr):
+        tables = _overcommit_tables(gd_block, k, S, exchange, x)
+        return jnp.concatenate(
+            [_vertex_aggregate(tables[j], _part_view(gd_block, j), S, aggr,
+                               interp) for j in range(k)], axis=0)
+
+    def attend(h, a_src, a_dst, slope):
+        kk, fd = h.shape[1], h.shape[2]
+        tables = _overcommit_tables(gd_block, k, S, exchange,
+                                    h.reshape(h.shape[0], kk * fd))
+        return jnp.concatenate(
+            [_vertex_attend(tables[j], _part_view(gd_block, j), S,
+                            h[j * S:(j + 1) * S], a_src, a_dst, slope)
+             for j in range(k)], axis=0)
+
+    return GraphCtx(aggregate=aggregate,
+                    in_degree=gd_block.in_degree.reshape(-1), attend=attend)
 
 
 def _padded_max_tax(meta) -> float:
@@ -584,14 +661,18 @@ class SpmdTrainer(BaseTrainer):
 
     def _place_nodes(self, part_loader, spec: NamedSharding, row_shape=()):
         """Assemble a global node tensor from per-part host blocks, placing
-        each part directly on its device.  Under `jax.distributed` each
-        process only loads/places the parts of its addressable devices
-        (possibly none — row_shape supplies the trailing dims so the global
-        shape never depends on local shards existing)."""
+        each part directly on its device (k consecutive parts stacked per
+        device under overcommit).  Under `jax.distributed` each process
+        only loads/places the parts of its addressable devices (possibly
+        none — row_shape supplies the trailing dims so the global shape
+        never depends on local shards existing)."""
         devices = list(self.mesh.devices.reshape(-1))
         pidx = jax.process_index()
-        shards = [jax.device_put(part_loader(p), d)
-                  for p, d in enumerate(devices) if d.process_index == pidx]
+        k = self.k
+        shards = [jax.device_put(
+            np.concatenate([part_loader(d * k + i) for i in range(k)])
+            if k > 1 else part_loader(d), dev)
+            for d, dev in enumerate(devices) if dev.process_index == pidx]
         global_shape = (self.part.num_parts * self.part.shard_nodes,) \
             + tuple(row_shape)
         return jax.make_array_from_single_device_arrays(
@@ -724,8 +805,15 @@ class SpmdTrainer(BaseTrainer):
         part_ids = self._local_part_ids()
         P_ = self.part.num_parts
 
+        k = self.k
+
         def place(leaf):
             arr = np.asarray(leaf)
+            if k > 1:          # single-process overcommit: all P parts here
+                shards = [jax.device_put(arr[d * k:(d + 1) * k], dev)
+                          for d, dev in enumerate(devices)]
+                return jax.make_array_from_single_device_arrays(
+                    (P_,) + arr.shape[1:], spec, shards)
             local = arr if arr.shape[0] == len(part_ids) else arr[part_ids]
             shards = [jax.device_put(local[i][None], devices[p])
                       for i, p in enumerate(part_ids)]
@@ -767,6 +855,8 @@ class SpmdTrainer(BaseTrainer):
             return False
         # "auto": only sum/avg aggregation is supported, and only skewed
         # partitions benefit (the padded-max tax IS the skew cost).
+        if self.k > 1:        # overcommit is vertex-mode only
+            return False
         aggrs = self._model_aggrs()
         if any(op.kind == "gat" for op in self.model.ops):
             return False
@@ -786,8 +876,26 @@ class SpmdTrainer(BaseTrainer):
         cfg, ds, model = self.config, self.dataset, self.model
         P_ = cfg.num_parts
         self.mesh = make_mesh(P_)
-        self.part = None
+        self.k = P_ // self.mesh.devices.size   # parts per device (>1 =
+        self.part = None                        # reference's overcommit)
         self._exchange_mode = cfg.exchange_mode()
+        if self.k > 1:
+            if jax.process_count() > 1 or cfg.perhost_load:
+                raise ValueError(
+                    "parts-per-device overcommit is single-process only; "
+                    "use num_parts == total devices under jax.distributed")
+            if self._exchange_mode == "ring" or cfg.edge_shard in (True,
+                                                                   "on"):
+                raise ValueError(
+                    f"num_parts={P_} > {self.mesh.devices.size} devices "
+                    f"(overcommit) supports the halo/allgather vertex "
+                    f"exchanges only; use -parts {self.mesh.devices.size} "
+                    f"for ring/edge-shard")
+            if jax.process_index() == 0 and cfg.verbose:
+                print(f"# overcommit: {P_} parts on "
+                      f"{self.mesh.devices.size} device(s), "
+                      f"k={self.k} shard blocks per device "
+                      f"(gnn.cc:61-63 numParts>numGPUs)", file=sys.stderr)
         if self._exchange_mode == "ring" and cfg.perhost_load:
             if jax.process_index() == 0:
                 print("# -exchange ring is incompatible with -perhost; "
@@ -863,11 +971,18 @@ class SpmdTrainer(BaseTrainer):
 
         exchange = self._exchange_mode
         optimizer = self.optimizer
+        k = self.k
         # pallas_call can't annotate vma yet; the matmul backend is plain XLA
         check_vma = gd.plans is None or gd.backend == "matmul"
 
+        def block_gctx(gd_block):
+            """Per-device GraphCtx: one part (squeezed) or k stacked."""
+            if k > 1:
+                return _shard_gctx_over(gd_block, S, k, exchange)
+            return _shard_gctx(_squeeze_gd(gd_block), S, exchange)
+
         def local_loss(params, x, labels, mask, gd_block, key):
-            gctx = _shard_gctx(gd_block, S, exchange)
+            gctx = block_gctx(gd_block)
             return model.loss(params, x, labels, mask, gctx, key=key,
                               train=True)
 
@@ -878,8 +993,8 @@ class SpmdTrainer(BaseTrainer):
                            P(PARTS_AXIS), gd_specs, P(), P()),
                  out_specs=(P(), P(), P()))
         def step_shard(params, opt_state, x, labels, mask, gd, key, alpha):
-            gd = _squeeze_gd(gd)
-            # per-shard dropout masks: fold the shard index into the key
+            # per-device dropout masks: fold the device index into the key
+            # (k stacked parts draw distinct rows of the same stream)
             key = jax.random.fold_in(key, jax.lax.axis_index(PARTS_AXIS))
             loss_l, grads_l = jax.value_and_grad(local_loss)(
                 params, x, labels, mask, gd, key)
@@ -896,8 +1011,7 @@ class SpmdTrainer(BaseTrainer):
                            gd_specs),
                  out_specs=P())
         def eval_shard(params, x, labels, mask, gd):
-            gd = _squeeze_gd(gd)
-            gctx = _shard_gctx(gd, S, exchange)
+            gctx = block_gctx(gd)
             logits = model.apply(params, x, gctx, train=False)
             m = ops.perf_metrics(logits, labels, mask)
             return jax.tree.map(lambda v: jax.lax.psum(v, PARTS_AXIS), m)
@@ -906,8 +1020,7 @@ class SpmdTrainer(BaseTrainer):
                  in_specs=(P(), P(PARTS_AXIS), gd_specs),
                  out_specs=P(PARTS_AXIS))
         def logits_shard(params, x, gd):
-            gd = _squeeze_gd(gd)
-            gctx = _shard_gctx(gd, S, exchange)
+            gctx = block_gctx(gd)
             return model.apply(params, x, gctx, train=False)
 
         self._train_step = jax.jit(step_shard, donate_argnums=(0, 1))

@@ -164,6 +164,103 @@ def test_ring_exchange_matches_halo_and_single_device():
         np.testing.assert_allclose(lr, l1, rtol=rtol, err_msg=f"epoch {i}")
 
 
+def test_overcommit_parts_per_device_match_single():
+    """num_parts > devices (the reference's parts>GPUs overcommit,
+    gnn.cc:61-63): 16 parts on the 8-device CPU mesh stack k=2 shard
+    blocks per device and must train equal to single-device and to the
+    one-part-per-device run — halo and allgather, GCN and sage-avg."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn, build_sage
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("over", 340, 4.0, 8, 4, n_train=60, n_val=60,
+                            n_test=60, seed=9)
+    layers = [8, 8, 4]
+    base = dict(layers=layers, num_epochs=2, dropout_rate=0.0,
+                eval_every=10 ** 9, edge_shard="off")
+    for halo in (True, False):
+        t1 = Trainer(Config(**base), ds, build_gcn(layers, 0.0))
+        t8 = SpmdTrainer(Config(**base, num_parts=8, halo=halo), ds,
+                         build_gcn(layers, 0.0))
+        t16 = SpmdTrainer(Config(**base, num_parts=16, halo=halo), ds,
+                          build_gcn(layers, 0.0))
+        assert t16.k == 2, "overcommit not engaged"
+        for i in range(2):
+            l1 = float(t1.run_epoch())
+            l8 = float(t8.run_epoch())
+            l16 = float(t16.run_epoch())
+            np.testing.assert_allclose(l16, l1, rtol=1e-4,
+                                       err_msg=f"halo={halo} epoch {i}")
+            np.testing.assert_allclose(l16, l8, rtol=1e-4,
+                                       err_msg=f"halo={halo} epoch {i}")
+    m1 = jax.device_get(t1.evaluate())
+    m16 = jax.device_get(t16.evaluate())
+    assert int(m1.val_correct) == int(m16.val_correct)
+
+    # sage-avg rides the same overcommit path (plan-less xla backend here)
+    t1s = Trainer(Config(**base, model="sage", aggr="avg"), ds,
+                  build_sage(layers, 0.0, aggr="avg"))
+    t16s = SpmdTrainer(Config(**base, model="sage", aggr="avg",
+                              num_parts=16, halo=True), ds,
+                       build_sage(layers, 0.0, aggr="avg"))
+    for i in range(2):
+        l1, l16 = float(t1s.run_epoch()), float(t16s.run_epoch())
+        np.testing.assert_allclose(l16, l1, rtol=1e-4, err_msg=f"epoch {i}")
+
+
+def test_overcommit_gat_and_plan_backend():
+    """Overcommit composes with the matmul plan backend and with GAT
+    (plan attention per stacked part)."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gat, build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("overg", 340, 4.0, 8, 4, n_train=60, n_val=60,
+                            n_test=60, seed=11)
+    layers = [8, 6, 4]
+    base = dict(layers=layers, num_epochs=2, dropout_rate=0.0,
+                eval_every=10 ** 9, edge_shard="off")
+    # GCN on the matmul plan backend
+    t1 = Trainer(Config(**base), ds, build_gcn(layers, 0.0))
+    t16 = SpmdTrainer(Config(**base, num_parts=16, halo=True,
+                             aggregate_backend="matmul"), ds,
+                      build_gcn(layers, 0.0))
+    assert t16.gdata.plans is not None
+    for i in range(2):
+        l1, l16 = float(t1.run_epoch()), float(t16.run_epoch())
+        np.testing.assert_allclose(l16, l1, rtol=1e-4, err_msg=f"epoch {i}")
+    # GAT, plan attention
+    g1 = Trainer(Config(**base, model="gat", heads=2), ds,
+                 build_gat(layers, 0.0, heads=2))
+    g16 = SpmdTrainer(Config(**base, model="gat", heads=2, num_parts=16,
+                             halo=True, aggregate_backend="matmul"), ds,
+                      build_gat(layers, 0.0, heads=2))
+    assert g16.gdata.gat_plans is not None
+    for i in range(2):
+        l1, l16 = float(g1.run_epoch()), float(g16.run_epoch())
+        np.testing.assert_allclose(l16, l1, rtol=1e-4, err_msg=f"epoch {i}")
+
+
+def test_overcommit_rejects_ring_and_edge_shard():
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+
+    ds = datasets.synthetic("overr", 200, 3.0, 8, 4, n_train=30, n_val=30,
+                            n_test=30, seed=3)
+    layers = [8, 8, 4]
+    for kw in (dict(exchange="ring"), dict(edge_shard=True)):
+        cfg = Config(layers=layers, num_epochs=1, dropout_rate=0.0,
+                     eval_every=10 ** 9, num_parts=16, **kw)
+        with pytest.raises(ValueError, match="overcommit"):
+            SpmdTrainer(cfg, ds, build_gcn(layers, 0.0))
+
+
 def test_ring_exchange_matmul_plans_match_xla():
     """-exchange ring -aggr-backend matmul (per-owner chunk plans,
     ring_owner_matmul — the ring fast path VERDICT r2 flagged missing)
