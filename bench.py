@@ -19,9 +19,12 @@ it.  vs_baseline > 1 means faster than that reference number.
 Env knobs:
   ROC_BENCH_BACKEND  aggregation backend: auto|xla|matmul|binned (default auto;
                      "pallas" is accepted as an alias of binned)
-  ROC_BENCH_PRECISION  aggregation precision for the matmul backend:
-                     fast (default; single-pass bf16 MXU, golden curves
-                     within +-1 sample of fp32 — docs/GOLDEN.md) | exact
+  ROC_BENCH_PRECISION  aggregation precision, honored by BOTH plan
+                     backends since round 3: fast (default; one designed
+                     bf16 feature rounding, golden curves within +-1
+                     sample of fp32 — docs/GOLDEN.md) | exact (fp32 end
+                     to end: matmul highest-precision dots, binned fp32
+                     staging + 3-way split dots)
   ROC_BENCH_EPOCHS   measured epochs (default 10)
   ROC_BENCH_SCALE    graph-size multiplier for smoke tests (default 1.0;
                      the canonical metric requires 1.0 — smaller scales

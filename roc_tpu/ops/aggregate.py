@@ -360,22 +360,26 @@ def pad_binned_plans(plans: "list[BinnedPlans]", min_fwd=(0, 0),
     return BinnedPlans(fwd=stack("fwd", min_fwd), bwd=stack("bwd", min_bwd))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2,))
-def scatter_gather_binned(x, plans: BinnedPlans, interpret: bool = False):
-    """Sum-aggregation via the binned two-phase kernels (fast path: one bf16
-    rounding of features, fp32 accumulation — the fp32-exact path is
-    :func:`scatter_gather_matmul`).  Differentiable w.r.t. x."""
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def scatter_gather_binned(x, plans: BinnedPlans, interpret: bool = False,
+                          precision: str = "fast"):
+    """Sum-aggregation via the binned two-phase kernels.  precision
+    "fast": one bf16 rounding of features, fp32 accumulation; "exact":
+    fp32 staging + 3-way bf16 split dots — fp32-exact like the matmul
+    backend, at the binned kernels' memory schedule (the round-3 answer
+    to "the fp32-exact path loses to the reference figure").
+    Differentiable w.r.t. x."""
     from roc_tpu.ops.pallas.binned import run_binned
-    return run_binned(x, plans.fwd, interpret)
+    return run_binned(x, plans.fwd, interpret, precision)
 
 
-def _bn_fwd(x, plans, interpret):
-    return scatter_gather_binned(x, plans, interpret), plans
+def _bn_fwd(x, plans, interpret, precision):
+    return scatter_gather_binned(x, plans, interpret, precision), plans
 
 
-def _bn_bwd(interpret, plans, g):
+def _bn_bwd(interpret, precision, plans, g):
     from roc_tpu.ops.pallas.binned import run_binned
-    gx = run_binned(g, plans.bwd, interpret)
+    gx = run_binned(g, plans.bwd, interpret, precision)
     zero = jax.tree.map(
         lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
     return gx, zero

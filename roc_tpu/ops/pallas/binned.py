@@ -32,10 +32,24 @@ restructure the data movement itself, radix-style:
 
 Cost per aggregation: read x G times (sequential) + write staging once
 (SLOT-row DMAs with block-cell run locality) + read staging once
-(sequential) + one-hot matmuls (~E*(SB+RB)*H MACs, bf16).  Staging rides
-bf16 — one-hot factors are exact, so features take exactly one bf16
-rounding; accumulation stays fp32.  The fp32-exact path remains the
-`matmul` backend (roc_tpu/ops/aggregate.py).
+(sequential) + one-hot matmuls (~E*(SB+RB)*H MACs, bf16).  Two precisions:
+
+  fast (default): staging rides bf16 — one-hot factors are exact, so
+  features take exactly ONE bf16 rounding; accumulation stays fp32
+  (golden curves within ±1 sample of fp32, docs/GOLDEN.md).
+
+  exact: fp32 staging + 3-way bf16 splits through the MXU.  A fp32 value
+  is hi+mid+lo of three bf16 roundings of successive residuals (8
+  mantissa bits each covers fp32's 24); each split-dot's products against
+  the EXACT one-hot factor are exact in fp32, so the only rounding is
+  the fp32 accumulation itself — the same rounding the reference's fp32
+  CUDA sums make (types.h:7).  Costs: 2x staging DMA bytes, 3x MXU MACs.
+  The FAST path's phases measured DMA-issue-bound on hardware (29%/44%
+  MXU, round 2, BASELINE.md), which predicts much of the extra compute
+  hides behind the same DMAs; the exact mode's own epoch time is
+  unmeasured until the next hardware window (tools/hw_revalidate.sh
+  step 2a).  The one-hot `matmul` backend (roc_tpu/ops/aggregate.py)
+  remains the plan-B exact path.
 
 Static-shape discipline: every (source-block, bin) cell is padded to a
 multiple of SLOT rows, every source block's chunk count and every bin's
@@ -305,8 +319,37 @@ def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
 # Phase-1 kernel: one-hot expand + slot-scatter to staging.
 # ---------------------------------------------------------------------------
 
+def _onehot_dot(t, xv, dims, exact: bool):
+    """One-hot contraction at either precision.
+
+    fast: single bf16 pass (the designed feature rounding).  exact: split
+    the fp32 operand into hi/mid/lo bf16 (bf16 roundings of successive
+    residuals; 3 x 8 mantissa bits cover fp32's 24), dot each against the
+    exact one-hot factor, sum in fp32 — bit-exact row selection/summation
+    up to fp32 accumulation order."""
+    if not exact:
+        return jax.lax.dot_general(t, xv.astype(jnp.bfloat16), dims,
+                                   preferred_element_type=jnp.float32)
+    xf = xv.astype(jnp.float32)
+    hi = xf.astype(jnp.bfloat16)
+    r1 = xf - hi.astype(jnp.float32)
+    mid = r1.astype(jnp.bfloat16)
+    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    out = jax.lax.dot_general(t, hi, dims,
+                              preferred_element_type=jnp.float32)
+    out += jax.lax.dot_general(t, mid, dims,
+                               preferred_element_type=jnp.float32)
+    out += jax.lax.dot_general(t, lo, dims,
+                               preferred_element_type=jnp.float32)
+    return out
+
+
+def _stg_dtype(exact: bool):
+    return jnp.float32 if exact else jnp.bfloat16
+
+
 def _p1_kernel_simple(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf,
-                      offbuf, sems):
+                      offbuf, sems, *, exact: bool = False):
     """Single-buffered fallback (ROC_BINNED_NO_PIPELINE=1): issue all slot
     DMAs then drain them in the same chunk.  No cross-chunk overlap, but
     structurally identical to the skeleton measured on hardware — keep as
@@ -316,9 +359,8 @@ def _p1_kernel_simple(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf,
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
     t = (lane == srcl_ref[:]).astype(jnp.bfloat16)
-    gbuf[0] = jax.lax.dot_general(
-        t, x_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    gbuf[0] = _onehot_dot(t, x_ref[:], (((1,), (0,)), ((), ())),
+                          exact).astype(_stg_dtype(exact))
 
     def issue(s, _):
         @pl.when(off_ref[c % 8, s] >= 0)
@@ -342,7 +384,7 @@ def _p1_kernel_simple(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf,
 
 
 def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
-               sems):
+               sems, *, exact: bool = False):
     """Double-buffered: the slot DMAs issued for chunk c drain at chunk
     c+2 (same gbuf parity), so the writes of one chunk overlap the next
     chunk's one-hot matmul.  ``offbuf`` keeps each parity's issued offsets
@@ -369,9 +411,8 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
     t = (lane == srcl_ref[:]).astype(jnp.bfloat16)
-    gbuf[par] = jax.lax.dot_general(
-        t, x_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    gbuf[par] = _onehot_dot(t, x_ref[:], (((1,), (0,)), ((), ())),
+                            exact).astype(_stg_dtype(exact))
 
     # off rides in (8, NSLOT) SMEM blocks; this chunk's row is c % 8.
     def issue(s, _):
@@ -396,12 +437,15 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
             drain_parity(1 - par)
 
 
-@partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret"))
+@partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret",
+                                   "exact"))
 def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
-            interpret: bool = False):
+            interpret: bool = False, exact: bool = False):
     kernel = _p1_kernel_simple \
         if os.environ.get("ROC_BINNED_NO_PIPELINE") else _p1_kernel
+    kernel = partial(kernel, exact=exact)
     H = x.shape[-1]
+    st = _stg_dtype(exact)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,                  # blk [C1]
         grid=(nchunks,),
@@ -412,13 +456,13 @@ def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
             pl.BlockSpec((SB, H), lambda c, blk: (blk[c], 0)),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.VMEM((2, CH, H), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((2, CH, H), st),
                         pltpu.SMEM((2, NSLOT), jnp.int32),
                         pltpu.SemaphoreType.DMA((2,))],
     )
     return pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((stg_rows, H), jnp.bfloat16),
+        out_shape=jax.ShapeDtypeStruct((stg_rows, H), st),
         interpret=interpret,
     )(blk, off, srcl, x)
 
@@ -427,7 +471,8 @@ def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
 # Phase-2 kernel: sequential staging read + windowed one-hot scatter.
 # ---------------------------------------------------------------------------
 
-def _p2_kernel(obi_ref, first_ref, dstl_ref, stg_ref, out_ref):
+def _p2_kernel(obi_ref, first_ref, dstl_ref, stg_ref, out_ref, *,
+               exact: bool = False):
     c = pl.program_id(0)
 
     @pl.when(first_ref[c] == 1)
@@ -436,17 +481,17 @@ def _p2_kernel(obi_ref, first_ref, dstl_ref, stg_ref, out_ref):
 
     # Zero-mask pad/garbage rows BEFORE the dot: a 0 one-hot coefficient
     # alone would still propagate NaN garbage (0 * NaN = NaN).
-    rows = jnp.where(dstl_ref[:] == RB, jnp.bfloat16(0), stg_ref[:])
+    zero = _stg_dtype(exact)(0)
+    rows = jnp.where(dstl_ref[:] == RB, zero, stg_ref[:])
     lane = jax.lax.broadcasted_iota(jnp.int32, (CH2, RB), 1)
     s_t = (lane == dstl_ref[:]).astype(jnp.bfloat16)   # [CH2, RB]
-    out_ref[:] += jax.lax.dot_general(
-        s_t, rows, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    out_ref[:] += _onehot_dot(s_t, rows, (((0,), (0,)), ((), ())), exact)
 
 
-@partial(jax.jit, static_argnames=("nchunks", "out_rows", "interpret"))
+@partial(jax.jit, static_argnames=("nchunks", "out_rows", "interpret",
+                                   "exact"))
 def _p2_run(stg, obi, first, dstl, nchunks: int, out_rows: int,
-            interpret: bool = False):
+            interpret: bool = False, exact: bool = False):
     H = stg.shape[-1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                  # obi, first
@@ -458,21 +503,31 @@ def _p2_run(stg, obi, first, dstl, nchunks: int, out_rows: int,
         out_specs=pl.BlockSpec((RB, H), lambda c, obi, first: (obi[c], 0)),
     )
     return pl.pallas_call(
-        _p2_kernel, grid_spec=grid_spec,
+        partial(_p2_kernel, exact=exact), grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((out_rows, H), jnp.float32),
         interpret=interpret,
     )(obi, first, dstl, stg)
 
 
-def run_binned(x, plan: BinnedPlan, interpret: bool = False):
+def run_binned(x, plan: BinnedPlan, interpret: bool = False,
+               precision: str = "fast"):
     """out[v] = sum over in-edges of x[src] via the two-phase schedule.
 
     x: [table_rows, H] (any float dtype) -> [num_rows, H] in x.dtype.
-    fp32 accumulation; features take one bf16 rounding (see module doc).
+    fp32 accumulation; precision "fast" rounds features once to bf16,
+    "exact" keeps fp32 end to end via 3-way bf16 splits (module doc).
+    A bf16 input makes the two identical, so exact quietly degrades to
+    the cheaper fast path there.
 
     Call under jit (the trainer always does): measured on v5e at Reddit
     scale, the eager path pays ~6x in scan dispatch overhead (1.65 s vs
     213 ms jitted — docs/PERF.md)."""
+    if precision not in ("fast", "exact"):
+        # same rule as ops.aggregate.matmul_precision: a silent fallthrough
+        # to the fast path would drop the fp32-exact guarantee
+        raise ValueError(f"precision={precision!r}: must be 'fast' or "
+                         f"'exact'")
+    exact = precision == "exact" and x.dtype == jnp.float32
     # Mosaic requires DMA slices lane-aligned to the (8,128) tile: the slot
     # DMAs out of gbuf slice the H axis, so H must be a multiple of 128
     # (observed hard error at H=41: "Slice shape along dimension 2 must be
@@ -488,9 +543,9 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False):
 
     def body(_, gplan):
         srcl, off, blk, dstl, obi, first = gplan
-        stg = _p1_run(xp, blk, off, srcl, C1, stg_rows, interpret)
+        stg = _p1_run(xp, blk, off, srcl, C1, stg_rows, interpret, exact)
         out_g = _p2_run(stg, obi, first, dstl, C2,
-                        plan.bins_per_group * RB, interpret)
+                        plan.bins_per_group * RB, interpret, exact)
         return None, out_g
 
     _, outs = jax.lax.scan(

@@ -1,0 +1,92 @@
+"""Per-device memory budget estimator (no arrays are allocated).
+
+Answers "does this graph geometry fit a chip's HBM?" BEFORE committing to
+an expensive build — the planning the reference does implicitly by sizing
+its framebuffer cache slots (`-ll:fsize`, resourcemanager.h:30,
+load_task.cu:365-374).  Used by the scale-guard tests
+(tests/test_scale_guard.py) to pin pod-scale geometries (papers100M on a
+v5p pod) against known HBM sizes, and usable interactively to pick
+`-parts` for a new graph.
+
+All terms are documented approximations of the dominant allocations; the
+point is catching order-of-magnitude regressions (a planner going
+quadratic, a staging buffer scaling with E instead of the group target),
+not byte-exact accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# HBM per chip, bytes (vendor-published capacities).
+HBM = {"v5e": 16e9, "v5p": 95e9, "v4": 32e9}
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceBudget:
+    """Bytes per device, by component, for one training configuration."""
+    features: float         # input feature shard
+    activations: float      # live fwd+bwd activations across the layer stack
+    labels_mask: float      # one-hot labels + mask shard
+    params: float           # replicated params + Adam moments (x3)
+    edges: float            # per-shard edge arrays (src/dst int32)
+    halo_table: float       # received halo rows at the widest layer
+    plans: float            # aggregation plan arrays (int32 schedules)
+    staging: float          # binned kernels' HBM staging stripe
+
+    @property
+    def total(self) -> float:
+        return sum(getattr(self, f.name) for f in dataclasses.fields(self))
+
+
+def estimate_device_bytes(num_nodes: int, num_edges: int, in_dim: int,
+                          hidden: int, num_classes: int, parts: int,
+                          *, layers: int = 2, dtype_bytes: int = 4,
+                          halo_fraction: float = 0.5,
+                          backend: str = "binned") -> DeviceBudget:
+    """Estimate per-device HBM for full-graph GCN-family training.
+
+    halo_fraction: fraction of a shard's rows also needed remotely (the
+    widest-layer halo table is ``S + halo_fraction * (P-1) * S`` rows in
+    the worst documented case; locality-heavy partitions measure far
+    lower).  backend "binned" adds the staging stripe (bounded by the
+    plan's group-row target, NOT by E — that bound is exactly what the
+    scale-guard test pins).
+    """
+    S = -(-num_nodes // parts)              # padded shard rows
+    E_shard = -(-num_edges // parts)
+    widest = max(in_dim, hidden)
+
+    features = S * in_dim * dtype_bytes
+    # fwd activations live across the backward pass: ~one [S, width] per
+    # layer boundary x2 (fwd value + grad in flight), plus XLA workspace.
+    activations = 2 * (layers + 1) * S * widest * dtype_bytes
+    labels_mask = S * (num_classes * 4 + 8)
+    # params replicated + Adam m/v (reference: grad replicas deleted,
+    # psum'd instead)
+    p = in_dim * hidden + (layers - 2) * hidden * hidden \
+        + hidden * num_classes
+    params = 3 * p * 4
+    edges = E_shard * 2 * 4
+    halo_rows = halo_fraction * (parts - 1) * S
+    halo_table = halo_rows * widest * dtype_bytes
+    if backend == "binned":
+        from roc_tpu.ops.pallas.binned import _GROUP_ROW_TARGET
+        # plan arrays ~O(E_shard) int32 across p1/p2 fwd+bwd (~24 B/edge
+        # measured); staging stripes at <= 2x the group-row target
+        # (slot-padding bound, binned_viable's 25% tax + rounding).
+        plans = 24.0 * E_shard
+        staging = min(2.0 * _GROUP_ROW_TARGET, 1.5 * E_shard) \
+            * widest * dtype_bytes
+    elif backend == "matmul":
+        from roc_tpu.ops.pallas.segment_sum import EB, VB
+        # 2 directions x (esrc+edst [C, EB] + obi/first [C]) int32, with
+        # C ~ E_shard/EB + S/VB empty-window floor
+        C = E_shard / EB + S / VB
+        plans = 2 * C * (2 * EB + 2) * 4
+        staging = 0.0
+    else:
+        plans = staging = 0.0
+    return DeviceBudget(features=features, activations=activations,
+                        labels_mask=labels_mask, params=params, edges=edges,
+                        halo_table=halo_table, plans=plans, staging=staging)

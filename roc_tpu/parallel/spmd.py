@@ -258,34 +258,37 @@ def build_edge_binned_plans(graph, meta, fwd_arrays=None):
                            bwd_base=jnp.asarray(b_bases, jnp.int32))
 
 
-def _eb_half(x, plan, base, interpret):
+def _eb_half(x, plan, base, interpret, precision):
     """One direction of binned edge-mode aggregation: all-gather the
     source table, binned sum over this block's window, place at the
     block's base, reduce onto owners (same shape as _edge_mm_half)."""
     from roc_tpu.ops.pallas.binned import run_binned
     table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)    # [NS, H]
     NS, H = table.shape
-    part_loc = run_binned(table, plan, interpret)            # [span, H]
+    part_loc = run_binned(table, plan, interpret, precision)  # [span, H]
     acc = jnp.zeros((NS, H), part_loc.dtype) + 0 * part_loc[:1, :1]
     acc = jax.lax.dynamic_update_slice(acc, part_loc, (base, 0))
     return jax.lax.psum_scatter(acc, PARTS_AXIS, scatter_dimension=0,
                                 tiled=True)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2,))
-def edge_aggregate_binned(x, eplans: EdgeBinnedPlans, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def edge_aggregate_binned(x, eplans: EdgeBinnedPlans, interpret,
+                          precision="fast"):
     """Edge-sharded sum aggregation on the binned backend (inside
     shard_map; plan payloads are this shard's block).  Backward = the
     same kernel over the transposed (src-sorted) block windows."""
-    return _eb_half(x, eplans.plans.fwd, eplans.fwd_base, interpret)
+    return _eb_half(x, eplans.plans.fwd, eplans.fwd_base, interpret,
+                    precision)
 
 
-def _eb_fwd(x, eplans, interpret):
-    return edge_aggregate_binned(x, eplans, interpret), eplans
+def _eb_fwd(x, eplans, interpret, precision):
+    return edge_aggregate_binned(x, eplans, interpret, precision), eplans
 
 
-def _eb_bwd(interpret, eplans, g):
-    dx = _eb_half(g, eplans.plans.bwd, eplans.bwd_base, interpret)
+def _eb_bwd(interpret, precision, eplans, g):
+    dx = _eb_half(g, eplans.plans.bwd, eplans.bwd_base, interpret,
+                  precision)
     zero = jax.tree.map(
         lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), eplans)
     return dx, zero
@@ -488,7 +491,8 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
                     f"edge-sharded aggregation supports sum/avg, not {aggr}"
                     " (use vertex sharding for max/min models)")
             if gd_block.backend == "binned" and gd_block.plans is not None:
-                out = edge_aggregate_binned(x, gd_block.plans, interp)
+                out = edge_aggregate_binned(x, gd_block.plans, interp,
+                                            gd_block.precision)
             elif gd_block.plans is not None:    # matmul backend: scatter-free
                 out = edge_aggregate_matmul(
                     x, gd_block.plans,
@@ -551,7 +555,8 @@ def _vertex_aggregate(table, gdj, S: int, aggr: str, interp: bool):
     _shard_gctx_over (k parts stacked per device)."""
     if gdj.plans is not None and aggr in ("sum", "avg"):
         if gdj.backend == "binned":
-            out = ops.scatter_gather_binned(table, gdj.plans, interp)
+            out = ops.scatter_gather_binned(table, gdj.plans, interp,
+                                            gdj.precision)
         else:
             out = ops.scatter_gather_matmul(
                 table, gdj.plans, S, table.shape[0],

@@ -43,12 +43,14 @@ class Config:
     heads: int = 8                # attention heads (gat only)
     aggr: str = ""                # "" = model default; sum|avg|max|min
     aggregate_backend: str = "auto"  # auto | xla | matmul | pallas(=binned) | binned
-    aggregate_precision: str = "exact"  # exact: fp32 one-hot dots (matches
-                                  # the reference's SGEMM); fast: single-pass
-                                  # bf16 MXU (features take one rounding —
-                                  # golden curves within +-1 sample,
-                                  # docs/GOLDEN.md; the binned backend is
-                                  # always 'fast' by construction)
+    aggregate_precision: str = "fast"  # fast (default): features take one
+                                  # designed bf16 rounding at aggregation
+                                  # input — golden curves within +-1 sample
+                                  # of fp32, docs/GOLDEN.md; exact: fp32 end
+                                  # to end on BOTH plan backends (matmul
+                                  # highest-precision dots; binned fp32
+                                  # staging + 3-way split dots).  Policy
+                                  # argument: BASELINE.md §precision.
     verbose: bool = False
     eval_every: int = 5           # reference evaluates every 5 epochs (gnn.cc:107)
     checkpoint_path: Optional[str] = None
@@ -100,7 +102,7 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-aggr", default="",
                    choices=["", "sum", "avg", "max", "min"])
     p.add_argument("-aggr-precision", dest="aggregate_precision",
-                   default="exact", choices=["exact", "fast"])
+                   default="fast", choices=["exact", "fast"])
     p.add_argument("-aggr-backend", dest="aggregate_backend", default="auto",
                    choices=["auto", "xla", "matmul", "pallas", "binned"])
     p.add_argument("-v", dest="verbose", action="store_true")

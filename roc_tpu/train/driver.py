@@ -142,7 +142,8 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
         # the live in-edge count — GraphSAGE-mean gets the plan backends).
         if g.plans is not None and aggr in ("sum", "avg"):
             if g.backend == "binned":
-                out = ops.scatter_gather_binned(x, g.plans, interp)
+                out = ops.scatter_gather_binned(x, g.plans, interp,
+                                                g.precision)
             else:
                 out = ops.scatter_gather_matmul(
                     x, g.plans, num_nodes, x.shape[0],

@@ -64,6 +64,79 @@ def test_binned_hub_source_and_dst():
     assert out[5, 0] == 1500.0 and np.all(out[:5] == 0) and np.all(out[6:] == 0)
 
 
+def oracle_fp32(x, src, dst, n):
+    """The exact path's contract: fp32 values, fp32 accumulation (the
+    reference's precision, types.h:7), differing only by sum order."""
+    out = np.zeros((n, x.shape[1]), np.float32)
+    np.add.at(out, dst, np.asarray(x)[src])
+    return out
+
+
+@pytest.mark.parametrize("n,t,e,h", CASES)
+def test_binned_exact_matches_fp32_oracle(n, t, e, h):
+    """precision="exact" (fp32 staging + 3-way bf16 split dots) must agree
+    with the fp32 oracle to reassociation-level error — and be strictly
+    tighter than the fast path's designed bf16 rounding."""
+    rng = np.random.default_rng(43)
+    src = rng.integers(0, t, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    x = rng.standard_normal((t, h), dtype=np.float32)
+    plan = build_binned_plan(src, dst, n, t, group_row_target=1 << 14)
+    out = np.asarray(run_binned(jnp.asarray(x), plan, interpret=True,
+                                precision="exact"))
+    ref = oracle_fp32(x, src, dst, n)
+    np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-5)
+    if e >= 5000:
+        # the fast path cannot meet the exact tolerance on this data —
+        # guards against "exact" silently running the fast kernels
+        fast = np.asarray(run_binned(jnp.asarray(x), plan, interpret=True))
+        assert np.abs(fast - ref).max() > 10 * np.abs(out - ref).max()
+
+
+def test_binned_exact_vjp():
+    rng = np.random.default_rng(11)
+    n, e, h = 300, 2000, 32
+    src = rng.integers(0, n, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    x = rng.standard_normal((n, h), dtype=np.float32)
+    g = rng.standard_normal((n, h), dtype=np.float32)
+    plans = ops.build_binned_plans(src, dst, n, n)
+    _, vjp = jax.vjp(
+        lambda x: ops.scatter_gather_binned(x, plans, True, "exact"), x)
+    (gx,) = vjp(jnp.asarray(g))
+    ref = oracle_fp32(g, dst, src, n)
+    np.testing.assert_allclose(np.asarray(gx), ref, rtol=2e-6, atol=1e-5)
+
+
+def test_binned_rejects_unknown_precision():
+    """Same rule as matmul_precision: a silent fallthrough to fast would
+    drop the fp32-exact guarantee."""
+    src = np.array([0], np.int64)
+    dst = np.array([1], np.int64)
+    plan = build_binned_plan(src, dst, 8, 8, group_row_target=1 << 14)
+    x = jnp.ones((8, 8), jnp.float32)
+    with pytest.raises(ValueError, match="precision"):
+        run_binned(x, plan, interpret=True, precision="highest")
+
+
+def test_binned_exact_degrades_to_fast_for_bf16_input():
+    """A bf16 input makes exact == fast; run_binned must take the cheap
+    path (same staging dtype) rather than pay 3x dots for nothing."""
+    from roc_tpu.ops.pallas import binned as B
+    rng = np.random.default_rng(12)
+    n, e, h = 256, 1000, 16
+    src = rng.integers(0, n, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    x = jnp.asarray(rng.standard_normal((n, h), dtype=np.float32)
+                    ).astype(jnp.bfloat16)
+    plan = B.build_binned_plan(src, dst, n, n, group_row_target=1 << 14)
+    out_e = run_binned(x, plan, interpret=True, precision="exact")
+    out_f = run_binned(x, plan, interpret=True, precision="fast")
+    assert out_e.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out_e, np.float32),
+                               np.asarray(out_f, np.float32))
+
+
 def test_binned_vjp_is_transposed_aggregation():
     rng = np.random.default_rng(7)
     n, e, h = 300, 2000, 32

@@ -127,6 +127,21 @@ def test_binned_avg_on_hw():
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=5e-2)
 
 
+def test_binned_exact_on_hw():
+    """precision="exact" (fp32 staging + 3-way split dots) compiled on the
+    chip — the fp32 staging doubles the slot-DMA widths and the split adds
+    two dots, both only provable under real Mosaic lowering.  Includes the
+    lane-unaligned H=41 case."""
+    from roc_tpu.ops.pallas.binned import build_binned_plan, run_binned
+    for n, t, src, dst, x in _cases():
+        plan = build_binned_plan(src, dst, n, t, group_row_target=1 << 17)
+        out = np.asarray(run_binned(jnp.asarray(x), plan, interpret=False,
+                                    precision="exact"))
+        ref = np.zeros((n, x.shape[1]), np.float32)
+        np.add.at(ref, dst, x[src])
+        np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-4)
+
+
 def test_gat_plan_on_hw():
     """Plan-backend attention (scatter-free fwd+bwd) compiled on the chip:
     value + gradient against the dense oracle at a lane-unaligned F."""
@@ -164,5 +179,6 @@ if __name__ == "__main__":   # direct hardware run, no pytest/conftest
     test_matmul_fast_precision_on_hw()
     test_binned_avg_on_hw()
     test_binned_no_pipeline_fallback_on_hw()
+    test_binned_exact_on_hw()
     test_gat_plan_on_hw()
     print("tpu hardware tests: all ok")

@@ -24,10 +24,21 @@ PYTHONPATH=/root/.axon_site:$PWD timeout 1200 python tests/test_tpu_hw.py \
 note "2. bench auto (expect binned, ~0.7 s/epoch)"
 timeout 1800 python bench.py 2>&1 | tail -3 | tee -a "$LOG"
 
+note "2a. fp32-exact epoch on the binned kernels (target: <= 1.0 s)"
+ROC_BENCH_PRECISION=exact ROC_BENCH_BACKEND=binned ROC_BENCH_EPOCHS=5 \
+    timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+
 note "2b. GAT epoch, plan-backend attention (target: within ~2x of GCN)"
 ROC_BENCH_MODEL=gat ROC_BENCH_LAYERS=602-64-41 ROC_BENCH_HEADS=4 \
     ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
     | tail -2 | tee -a "$LOG"
+
+note "2c. overcommit: 4 parts on the 1 bench chip (first hardware run of"
+note "    the multi-part paths: halo all_to_all, per-part plans, psum)"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -v 2>&1 | tail -2 | tee -a "$LOG"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -no-halo -v 2>&1 | tail -2 | tee -a "$LOG"
 
 note "3. group-count sweep (fewer groups -> less phase-1 rounding)"
 for grt in 2097152 4194304 8388608; do
