@@ -528,6 +528,11 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False,
         raise ValueError(f"precision={precision!r}: must be 'fast' or "
                          f"'exact'")
     exact = precision == "exact" and x.dtype == jnp.float32
+    if precision == "exact" and x.dtype not in (jnp.float32, jnp.bfloat16):
+        # bf16 degrades to fast losslessly (identical semantics); any
+        # other dtype would silently round through bf16 staging
+        raise ValueError(f"precision='exact' supports float32/bfloat16 "
+                         f"inputs, got {x.dtype}")
     # Mosaic requires DMA slices lane-aligned to the (8,128) tile: the slot
     # DMAs out of gbuf slice the H axis, so H must be a multiple of 128
     # (observed hard error at H=41: "Slice shape along dimension 2 must be

@@ -80,10 +80,15 @@ def estimate_device_bytes(num_nodes: int, num_edges: int, in_dim: int,
             * widest * dtype_bytes
     elif backend == "matmul":
         from roc_tpu.ops.pallas.segment_sum import EB, VB
-        # 2 directions x (esrc+edst [C, EB] + obi/first [C]) int32, with
-        # C ~ E_shard/EB + S/VB empty-window floor
-        C = E_shard / EB + S / VB
-        plans = 2 * C * (2 * EB + 2) * 4
+        # per direction: esrc+edst [C, EB] + obi/first [C] int32.  The fwd
+        # empty-window floor spans the shard's S rows, but the BWD floor
+        # spans the whole halo TABLE (grad flows onto every received row)
+        # — the dominant term at halo-heavy shapes (measured 55 B/edge at
+        # products shape, docs/PERF.md).
+        table = S + halo_rows
+        C_fwd = E_shard / EB + S / VB
+        C_bwd = E_shard / EB + table / VB
+        plans = (C_fwd + C_bwd) * (2 * EB + 2) * 4
         staging = 0.0
     else:
         plans = staging = 0.0

@@ -334,27 +334,32 @@ def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
     if backend == "binned":
         plan_list = [ops.build_binned_plans(srcs[i], dsts[i], S, table_rows)
                      for i in range(len(srcs))]
-        floors = ((0, 0), (0, 0))
-        if allgather is not None:
-            counts = np.asarray(
-                [[p.fwd.p1_blk.shape[1] for p in plan_list],
-                 [p.fwd.p2_obi.shape[1] for p in plan_list],
-                 [p.bwd.p1_blk.shape[1] for p in plan_list],
-                 [p.bwd.p2_obi.shape[1] for p in plan_list]], np.int64)
-            g = allgather(counts.max(axis=1)).max(axis=0)
-            floors = ((int(g[0]), int(g[1])), (int(g[2]), int(g[3])))
-        return ops.pad_binned_plans(plan_list, min_fwd=floors[0],
-                                    min_bwd=floors[1])
+        f = _allgather_floors(
+            [[p.fwd.p1_blk.shape[1] for p in plan_list],
+             [p.fwd.p2_obi.shape[1] for p in plan_list],
+             [p.bwd.p1_blk.shape[1] for p in plan_list],
+             [p.bwd.p2_obi.shape[1] for p in plan_list]], allgather)
+        return ops.pad_binned_plans(plan_list, min_fwd=(f[0], f[1]),
+                                    min_bwd=(f[2], f[3]))
     plan_list = [ops.build_aggregate_plans(srcs[i], dsts[i], S, table_rows)
                  for i in range(len(srcs))]
-    min_fwd = min_bwd = 0
-    if allgather is not None:
-        counts = np.asarray([[p.fwd_obi.shape[0] for p in plan_list],
-                             [p.bwd_obi.shape[0] for p in plan_list]],
-                            np.int64)
-        g = allgather(counts.max(axis=1)).max(axis=0)
-        min_fwd, min_bwd = int(g[0]), int(g[1])
-    return ops.pad_plans(plan_list, min_fwd=min_fwd, min_bwd=min_bwd)
+    f = _allgather_floors([[p.fwd_obi.shape[0] for p in plan_list],
+                           [p.bwd_obi.shape[0] for p in plan_list]],
+                          allgather)
+    return ops.pad_plans(plan_list, min_fwd=f[0], min_bwd=f[1])
+
+
+def _allgather_floors(counts, allgather) -> "list[int]":
+    """Cross-process static-shape floors: local per-side maxima →
+    allgather → global maxima.  Every process must compile the SAME
+    shard_map program, so per-shard pad targets take the global max chunk
+    count per side.  ``counts``: [n_sides][n_local_shards] ints;
+    ``allgather`` None (single-process) returns the local maxima."""
+    local = np.asarray(counts, np.int64).max(axis=1)
+    if allgather is None:
+        return [int(v) for v in local]
+    g = np.asarray(allgather(local)).max(axis=0)
+    return [int(v) for v in np.reshape(g, -1)]
 
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
@@ -765,7 +770,8 @@ class SpmdTrainer(BaseTrainer):
         return shard_graph(self.part, self.halo, backend,
                            cfg.aggregate_precision, gat_backend=gat_backend)
 
-    def _build_graph_perhost(self, backend: str) -> ShardedGraphData:
+    def _build_graph_perhost(self, backend: str,
+                             gat_backend: str = "xla") -> ShardedGraphData:
         """Pod-scale path: this process reads only its parts' `.lux` byte
         ranges and builds only local rows of every [P, ...] array (see
         roc_tpu/graph/shard_load.py).  Returned leaves have L rows; the
@@ -787,17 +793,28 @@ class SpmdTrainer(BaseTrainer):
         self.halo = lhalo
         P_, S = meta.num_parts, meta.shard_nodes
         src = lhalo.edge_src_local if lhalo is not None else local.edge_src
+        table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
         plans = None
         if backend in ("matmul", "binned"):
-            table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
             plans = _build_shard_plans(backend, src, local.edge_dst, S,
                                        table_rows, allgather=ag)
+        gat_plans = None
+        if gat_backend == "plan":
+            from roc_tpu.ops.edge import build_gat_plans, pad_gat_plans
+            local_plans = [build_gat_plans(src[i], local.edge_dst[i], S,
+                                           table_rows)
+                           for i in range(len(part_ids))]
+            f = _allgather_floors(
+                [[p.dst_obi.shape[0] for p in local_plans],
+                 [p.src_obi.shape[0] for p in local_plans]], ag)
+            gat_plans = pad_gat_plans(local_plans, min_d=f[0], min_s=f[1])
         return ShardedGraphData(
             edge_src=jnp.asarray(src, jnp.int32),
             edge_dst=jnp.asarray(local.edge_dst, jnp.int32),
             in_degree=jnp.asarray(local.in_degree, jnp.float32),
             send_idx=None if lhalo is None else jnp.asarray(lhalo.send_idx),
             plans=plans,
+            gat_plans=gat_plans,
             backend=backend,
             precision=cfg.aggregate_precision)
 
@@ -933,13 +950,13 @@ class SpmdTrainer(BaseTrainer):
             backend = "matmul"
 
         # Plan-backend attention composes with halo/allgather vertex
-        # sharding (ring/edge modes raise for GAT; perhost keeps the
-        # chunked-scan fallback — its plan-count allgather is not wired).
+        # sharding, single-host or perhost (ring/edge modes raise for GAT).
         gat_backend = self._gat_backend() \
-            if not (cfg.perhost_load or self._use_edge_shard
+            if not (self._use_edge_shard
                     or self._exchange_mode == "ring") else "xla"
-        gd = self._build_graph_perhost(backend) if cfg.perhost_load \
-            else self._build_graph_full(backend, gat_backend)
+        gd = self._build_graph_perhost(backend, gat_backend) \
+            if cfg.perhost_load else self._build_graph_full(backend,
+                                                            gat_backend)
         if cfg.verbose:
             self._log_shard_stats()
         S = self.part.shard_nodes

@@ -62,11 +62,24 @@ def main():
     assert epoch2 == trainer.epoch
     assert extra2 == {"tag": "mh", "nprocs": nprocs}
 
+    # Plan-backend GAT under per-host loading: each process builds its
+    # local parts' attention plans, floors allgathered so the compiled
+    # program agrees across processes (round-3 feature).
+    from roc_tpu.models import build_gat
+    cfg_g = Config(layers=[12, 8, 5], num_epochs=2, dropout_rate=0.0,
+                   num_parts=num_parts, halo=True, perhost_load=True,
+                   filename=prefix, eval_every=10**9, model="gat", heads=2,
+                   aggregate_backend="matmul")
+    tr_g = SpmdTrainer(cfg_g, ds, build_gat(cfg_g.layers, 0.0, heads=2))
+    assert tr_g.gdata.gat_plans is not None, "perhost GAT plans not built"
+    gat_losses = [float(tr_g.run_epoch()) for _ in range(2)]
+
     out = {
         "proc": proc_id,
         "saves": len(saves),
         "metrics": {k: float(getattr(m, k)) for k in m._fields},
         "ckpt_exists": os.path.exists(ckpt),
+        "gat_losses": gat_losses,
     }
     with open(os.path.join(outdir, f"out_{proc_id}.json"), "w") as f:
         json.dump(out, f)

@@ -223,6 +223,31 @@ def test_gat_plan_sharded_equals_single():
     assert int(m1.val_correct) == int(mp.val_correct)
 
 
+def test_gat_plan_perhost_equals_full_load(tmp_path):
+    """Plan attention under -perhost (per-host `.lux` slice loading):
+    the per-host-built, floor-padded plans must train identically to the
+    full-load sharded run."""
+    from roc_tpu.graph import lux
+
+    ds, g, _ = graph_and_x(n=240)
+    prefix = str(tmp_path / "g")
+    lux.write_dataset(prefix, ds.graph, ds.features, ds.label_ids, ds.mask)
+    layers = [ds.in_dim, 6, ds.num_classes]
+    base = dict(layers=layers, num_epochs=2, dropout_rate=0.0,
+                eval_every=10**9, num_parts=4, halo=True,
+                aggregate_backend="matmul")
+    tp = SpmdTrainer(Config(**base), ds, build_gat(layers, 0.0, heads=2))
+    from roc_tpu.graph import datasets as dsets
+    ds_stub = dsets.load_roc_dataset(prefix, ds.in_dim, ds.num_classes,
+                                     graph_stub=True)
+    th = SpmdTrainer(Config(**base, perhost_load=True, filename=prefix),
+                     ds_stub, build_gat(layers, 0.0, heads=2))
+    assert th.gdata.gat_plans is not None, "perhost plan attention off"
+    for i in range(2):
+        lp, lh = float(tp.run_epoch()), float(th.run_epoch())
+        np.testing.assert_allclose(lh, lp, rtol=1e-4, err_msg=f"epoch {i}")
+
+
 def test_gat_training_learns():
     ds, g, _ = graph_and_x(n=200)
     cfg = Config(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=30,

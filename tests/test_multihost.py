@@ -96,3 +96,15 @@ def test_two_process_training(roc_prefix, tmp_path):
         a, b = float(getattr(ref, k)), m0[k]
         tol = 1e-3 * max(abs(a), 1.0) if k == "train_loss" else 0.0
         assert abs(a - b) <= tol, (k, a, b)
+
+    # perhost plan-backend GAT (round 3): both processes agree, and the
+    # losses match a single-process full-load run of the same config
+    assert results[0]["gat_losses"] == results[1]["gat_losses"]
+    from roc_tpu.models import build_gat
+    cfg_g = Config(layers=[12, 8, 5], num_epochs=2, dropout_rate=0.0,
+                   num_parts=8, halo=True, eval_every=10**9, model="gat",
+                   heads=2, aggregate_backend="matmul")
+    tr_g = SpmdTrainer(cfg_g, datasets.load_roc_dataset(prefix, 12, 5),
+                       build_gat(cfg_g.layers, 0.0, heads=2))
+    ref_g = [float(tr_g.run_epoch()) for _ in range(2)]
+    np.testing.assert_allclose(results[0]["gat_losses"], ref_g, rtol=1e-4)
