@@ -391,9 +391,9 @@ def _edge_contract(du, table, edge_src, edge_dst, dz):
     return de.reshape(nchunks * chunk, K)[:E]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(6,))
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7))
 def gat_attend_plan(h, table, a_src, a_dst, plans: GatPlans, edge_ids,
-                    slope: float):
+                    slope: float, precision: str = "highest"):
     """GAT attention over chunk plans — scatter-free fwd AND bwd.
 
     Same semantics as :func:`gat_attend` (equal up to float reassociation:
@@ -401,12 +401,20 @@ def gat_attend_plan(h, table, a_src, a_dst, plans: GatPlans, edge_ids,
     arrays in dst-sorted order (table-local src ids under halo).  The
     backward is hand-derived so no gather is ever transposed into a TPU
     scatter; all reductions ride the dst-/src-keyed plans.
+
+    ``precision`` feeds ONLY the two [*, K, F] weighted feature sums (u
+    fwd, dtable bwd) — the FLOP carriers; "default" is the fast policy's
+    single-pass bf16 (one feature rounding).  The [E, K] score/normalizer
+    sums stay at "highest" always: their FLOPs are negligible and the
+    softmax normalization stays exact in both modes.
     """
-    out, _ = _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope)
+    out, _ = _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope,
+                           precision)
     return out
 
 
-def _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope):
+def _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope,
+                  precision="highest"):
     edge_src, edge_dst = edge_ids
     N = plans.num_rows
     K, F = h.shape[1], h.shape[2]
@@ -421,7 +429,7 @@ def _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope):
     z = _plan_sum(e, None, plans.dst_obi, plans.dst_edst, plans.dst_pos,
                   plans.dst_nid, N, "highest")            # [N, K]
     u = _plan_sum(e, table, plans.dst_obi, plans.dst_edst, plans.dst_pos,
-                  plans.dst_nid, N, "highest")            # [N, K, F]
+                  plans.dst_nid, N, precision)            # [N, K, F]
     # Guard must be a NORMAL float: XLA flushes subnormals (1e-38) to zero,
     # and rows with no in-edges (padded shard rows) have z == 0 → 0/0 NaN.
     # Any live row has z >= 1 (the max edge contributes exp(0)).
@@ -431,7 +439,7 @@ def _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope):
                  q >= 0, e, zc, out)
 
 
-def _gat_plan_bwd(slope, res, gout):
+def _gat_plan_bwd(slope, precision, res, gout):
     h, table, a_src, a_dst, plans, edge_ids, qpos, e, zc, out = res
     edge_src, edge_dst = edge_ids
     N, T = plans.num_rows, plans.table_rows
@@ -445,7 +453,7 @@ def _gat_plan_bwd(slope, res, gout):
     dast = _plan_sum(dq, None, plans.src_obi, plans.src_edst, plans.src_pos,
                      plans.src_nid, T, "highest")         # [T, K]
     dtable = _plan_sum(e, du, plans.src_obi, plans.src_edst, plans.src_pos,
-                       plans.src_nid, T, "highest")       # [T, K, F]
+                       plans.src_nid, T, precision)       # [T, K, F]
     dtable = dtable + dast[:, :, None] * a_src[None]
     dh = dadl[:, :, None] * a_dst[None]
     da_src = jnp.einsum("tk,tkf->kf", dast, table)

@@ -589,7 +589,8 @@ def _vertex_attend(table_flat, gdj, S: int, h_local, a_src, a_dst, slope):
         av = jax.lax.pcast(a_src, PARTS_AXIS, to="varying")
         dv = jax.lax.pcast(a_dst, PARTS_AXIS, to="varying")
         return gat_attend_plan(h_local, tab, av, dv, gdj.gat_plans,
-                               (gdj.edge_src, gdj.edge_dst), slope)
+                               (gdj.edge_src, gdj.edge_dst), slope,
+                               ops.matmul_precision(gdj.precision))
     return ops.gat_attend(h_local, tab, gdj.edge_src, gdj.edge_dst, S,
                           a_src, a_dst, slope)
 

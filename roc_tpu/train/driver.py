@@ -158,7 +158,8 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
         if g.gat_plans is not None:
             from roc_tpu.ops.edge import gat_attend_plan
             return gat_attend_plan(h, h, a_src, a_dst, g.gat_plans,
-                                   (g.edge_src, g.edge_dst), slope)
+                                   (g.edge_src, g.edge_dst), slope,
+                                   ops.matmul_precision(g.precision))
         return ops.gat_attend(h, h, g.edge_src, g.edge_dst, num_nodes,
                               a_src, a_dst, slope)
 

@@ -107,4 +107,7 @@ def test_two_process_training(roc_prefix, tmp_path):
     tr_g = SpmdTrainer(cfg_g, datasets.load_roc_dataset(prefix, 12, 5),
                        build_gat(cfg_g.layers, 0.0, heads=2))
     ref_g = [float(tr_g.run_epoch()) for _ in range(2)]
-    np.testing.assert_allclose(results[0]["gat_losses"], ref_g, rtol=1e-4)
+    # same tolerance policy as the GCN train_loss check above: the
+    # 2-process gloo psum reassociates float sums differently from the
+    # single-process virtual mesh
+    np.testing.assert_allclose(results[0]["gat_losses"], ref_g, rtol=1e-3)
