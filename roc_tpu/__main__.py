@@ -38,10 +38,6 @@ def main(argv=None) -> int:
         print("error: -exchange ring and -edge-shard are mutually "
               "exclusive distribution strategies", file=sys.stderr)
         return 2
-    if cfg.exchange == "ring" and cfg.model == "gat":
-        print("error: -exchange ring cannot serve GAT attention (needs a "
-              "materialized source table); use -exchange halo", file=sys.stderr)
-        return 2
     if cfg.edge_shard in (True, "on") and (
             cfg.num_parts < 2 or cfg.perhost_load or cfg.model == "gat"
             or cfg.aggr in ("max", "min")):

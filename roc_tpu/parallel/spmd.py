@@ -461,10 +461,13 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
         return (buf, acc), None
 
     H = x.shape[-1]
-    # `+ 0 * x[:1, :1]`: the scan carry must share x's device-varying vma
-    # annotation under shard_map (same workaround as the matmul acc below).
-    init = jnp.full((S, H), {"sum": 0.0, "max": -jnp.inf, "min": jnp.inf}
-                    [base], x.dtype) + 0 * x[:1, :1]
+    # pcast: the scan carry must share x's device-varying vma annotation
+    # under shard_map.  NOT the `+ 0 * x` trick — with a non-finite init
+    # (max/min) that creates a gradient edge into x through which a
+    # non-finite cotangent can NaN-poison dx (bug found in _ring_attend).
+    init = jax.lax.pcast(
+        jnp.full((S, H), {"sum": 0.0, "max": -jnp.inf, "min": jnp.inf}
+                 [base], x.dtype), PARTS_AXIS, to="varying")
     (_, acc), _ = jax.lax.scan(step, (x, init), jnp.arange(P_))
     if aggr == "avg":
         acc = ops.divide_by_degree(acc, gd_block.in_degree)
@@ -475,6 +478,85 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
         empty = jnp.isneginf(acc) if base == "max" else jnp.isposinf(acc)
         acc = jnp.where(empty, 0, acc)
     return acc
+
+
+def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
+    """GAT attention in ring mode — LITERAL ring attention on the vertex/
+    context axis (SURVEY §5.7: the vertex-shard axis IS the sequence axis).
+
+    No source table is ever materialized: shards rotate with ppermute and
+    each step folds the visiting owner's edge group into an ONLINE softmax
+    (flash/ring-attention recurrence): running per-destination max m,
+    normalizer z, and unnormalized output u, rescaled by exp(m_old−m_new)
+    as the max tightens.  Peak memory is two [S, K, F] buffers + the
+    accumulators — the property that lets ring attention scale to
+    contexts (here: graphs) whose gathered tables would not fit.
+
+    The per-step body is rematerialized (jax.checkpoint) so autodiff
+    recomputes each owner group's scores instead of stacking P steps of
+    residuals.  Pad edges carry dst = S (masked); destinations with no
+    in-edges anywhere keep z = 0 and emit 0 (same convention as the
+    table-based paths).
+    """
+    P_ = gd_block.ring_src.shape[0]
+    K, F = h.shape[1], h.shape[2]
+    p = jax.lax.axis_index(PARTS_AXIS)
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+    ad_l = jnp.einsum("nkf,kf->nk", h, a_dst)             # [S, K]
+    ad_pad = jnp.concatenate([ad_l, jnp.zeros((1, K), ad_l.dtype)])
+    # "No mass yet" sentinel is a FINITE large negative, not -inf: every
+    # arising exp(sentinel - x) underflows cleanly to 0 in fwd AND bwd,
+    # whereas -inf sentinels produce -inf - -inf = NaN in where-branch
+    # forwards whose vjps then feed 0 * NaN into the scan-carry gradient
+    # (the standard where-NaN-grad trap; first hit here, hence the note).
+    NEG = jnp.float32(-1e30)
+
+    def step(carry, k):
+        buf, m, z, u = carry
+        owner = jax.lax.rem(p - k + P_, P_)
+        es = jnp.take(gd_block.ring_src, owner, axis=0)   # [Eo]
+        ed = jnp.take(gd_block.ring_dst, owner, axis=0)   # [Eo], pad = S
+        as_t = jnp.einsum("nkf,kf->nk", buf, a_src)       # [S, K]
+        s = jax.nn.leaky_relu(
+            jnp.take(ad_pad, ed, axis=0) + jnp.take(as_t, es, axis=0),
+            negative_slope=slope)                          # [Eo, K]
+        # pad rows must not move the max: sink them to the sentinel
+        s = jnp.where((ed == S)[:, None], NEG, s)
+        m_step = jax.ops.segment_max(s, ed, num_segments=S + 1,
+                                     indices_are_sorted=True)[:S]
+        m_step = jnp.maximum(m_step, NEG)      # empty segments: -inf → NEG
+        m_new = jnp.maximum(m, m_step)
+        m_new = jax.lax.stop_gradient(m_new)   # softmax shift-invariance
+        shift = jnp.concatenate(
+            [m_new, jnp.zeros((1, K), m_new.dtype)])[ed]
+        e = jnp.exp(s - shift)     # pads: exp(NEG - 0) underflows to 0
+        z_step = jax.ops.segment_sum(e, ed, num_segments=S + 1,
+                                     indices_are_sorted=True)[:S]
+        g = jnp.take(buf, es, axis=0)                     # [Eo, K, F]
+        u_step = jax.ops.segment_sum(g * e[:, :, None], ed,
+                                     num_segments=S + 1,
+                                     indices_are_sorted=True)[:S]
+        # rescale prior mass to the tightened max; no-mass-yet rows have
+        # m == NEG and m_new either still NEG (scale exp(0)=1 on zero
+        # mass — harmless) or real (scale underflows to 0)
+        scale = jnp.exp(m - m_new)
+        z = z * scale + z_step
+        u = u * scale[:, :, None] + u_step
+        buf = jax.lax.ppermute(buf, PARTS_AXIS, perm)
+        return (buf, m_new, z, u), None
+
+    # carries must share h's device-varying vma; pcast annotates without
+    # creating a (zero-valued but NaN-propagating) gradient edge into h
+    # the way the `+ 0 * h` trick would
+    m0 = jax.lax.pcast(jnp.full((S, K), NEG), PARTS_AXIS, to="varying")
+    z0 = jax.lax.pcast(jnp.zeros((S, K)), PARTS_AXIS, to="varying")
+    u0 = jax.lax.pcast(jnp.zeros((S, K, F)), PARTS_AXIS, to="varying")
+    (_, _, z, u), _ = jax.lax.scan(
+        jax.checkpoint(step, prevent_cse=False), (h, m0, z0, u0),
+        jnp.arange(P_))
+    # 1e-20, not 1e-38: subnormals flush to zero under XLA (0/0 on
+    # edgeless rows); live rows have z >= 1 by the max shift
+    return u / jnp.maximum(z, 1e-20)[:, :, None]
 
 
 def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
@@ -525,9 +607,8 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
             return _ring_aggregate(gd_block, shard_nodes, x, aggr)
 
         def attend_ring(h, a_src, a_dst, slope):
-            raise NotImplementedError(
-                "GAT attention needs a materialized source table; use "
-                "-exchange halo or allgather")
+            return _ring_attend(gd_block, shard_nodes, h, a_src, a_dst,
+                                slope)
 
         return GraphCtx(aggregate=aggregate_ring,
                         in_degree=gd_block.in_degree, attend=attend_ring)
@@ -951,7 +1032,9 @@ class SpmdTrainer(BaseTrainer):
             backend = "matmul"
 
         # Plan-backend attention composes with halo/allgather vertex
-        # sharding, single-host or perhost (ring/edge modes raise for GAT).
+        # sharding, single-host or perhost.  Ring mode attends via its own
+        # online-softmax recurrence (_ring_attend — no plans, no table);
+        # only -edge-shard still rejects GAT.
         gat_backend = self._gat_backend() \
             if not (self._use_edge_shard
                     or self._exchange_mode == "ring") else "xla"

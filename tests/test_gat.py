@@ -223,6 +223,31 @@ def test_gat_plan_sharded_equals_single():
     assert int(m1.val_correct) == int(mp.val_correct)
 
 
+def test_gat_ring_attention_equals_single():
+    """-exchange ring + GAT = literal ring attention (online softmax over
+    rotating shards, two-buffer memory, no source table).  Must train
+    equal to the single-device and halo runs up to fp32 reassociation."""
+    ds, g, _ = graph_and_x(n=220)
+    layers = [ds.in_dim, 6, ds.num_classes]
+    base = dict(layers=layers, num_epochs=3, dropout_rate=0.0,
+                eval_every=10**9, edge_shard="off")
+    t1 = Trainer(Config(**base), ds, build_gat(layers, 0.0, heads=2))
+    th = SpmdTrainer(Config(**base, num_parts=4, halo=True), ds,
+                     build_gat(layers, 0.0, heads=2))
+    tr = SpmdTrainer(Config(**base, num_parts=4, exchange="ring"), ds,
+                     build_gat(layers, 0.0, heads=2))
+    assert tr.gdata.mode == "ring"
+    for i, rtol in enumerate((2e-5, 5e-3, 5e-3)):
+        l1 = float(t1.run_epoch())
+        lh = float(th.run_epoch())
+        lr = float(tr.run_epoch())
+        np.testing.assert_allclose(lr, l1, rtol=rtol, err_msg=f"epoch {i}")
+        np.testing.assert_allclose(lr, lh, rtol=rtol, err_msg=f"epoch {i}")
+    m1 = jax.device_get(t1.evaluate())
+    mr = jax.device_get(tr.evaluate())
+    assert int(m1.val_correct) == int(mr.val_correct)
+
+
 def test_gat_plan_perhost_equals_full_load(tmp_path):
     """Plan attention under -perhost (per-host `.lux` slice loading):
     the per-host-built, floor-padded plans must train identically to the
