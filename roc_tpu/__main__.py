@@ -39,11 +39,11 @@ def main(argv=None) -> int:
               "exclusive distribution strategies", file=sys.stderr)
         return 2
     if cfg.edge_shard in (True, "on") and (
-            cfg.num_parts < 2 or cfg.perhost_load or cfg.model == "gat"
+            cfg.num_parts < 2 or cfg.perhost_load
             or cfg.aggr in ("max", "min")):
         print("error: -edge-shard supports sum/avg aggregation, needs "
-              "-parts > 1, and is incompatible with -perhost and "
-              "-model gat", file=sys.stderr)
+              "-parts > 1, and is incompatible with -perhost",
+              file=sys.stderr)
         return 2
     if cfg.perhost_load and cfg.check_sharding:
         # the checker's single-device reference needs the whole graph on one

@@ -480,6 +480,65 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
     return acc
 
 
+def _edge_attend(gd_block, h, a_src, a_dst, slope: float):
+    """GAT attention under edge sharding — the last cell of the
+    model × distribution matrix.
+
+    The softmax couples edges of one destination across blocks (a vertex's
+    in-edges may be split mid-vertex — that is edge sharding's point), so
+    the per-destination max and normalizer become collectives: each block
+    scores its own Eb edges against the all-gathered table, block-local
+    segment maxima combine with one `pmax`, and the shifted exp sums /
+    weighted sums reduce onto owners with `psum_scatter` — the same
+    all_gather + psum_scatter shape as the edge-mode sum path, plus one
+    [NS, K] pmax for the shift.  Work is exactly Eb edges per device under
+    ANY skew (the property the mode exists for).  Pad edges land on pad
+    node rows (in-range, masked by the mask=NONE convention downstream).
+
+    Backward is jax autodiff: the segment ops transpose into TPU scatters,
+    so on hardware this is the correctness path, not the fast path — the
+    plan treatment (windowed per-block schedules like EdgePlans) is the
+    known follow-up if edge-sharded attention ever becomes hot.
+    """
+    S, K, F = h.shape[0], h.shape[1], h.shape[2]
+    table = jax.lax.all_gather(
+        h.reshape(S, K * F), PARTS_AXIS, tiled=True).reshape(-1, K, F)
+    NS = table.shape[0]
+    es, ed = gd_block.edge_src, gd_block.edge_dst   # [Eb] padded-global
+    # project locally ([S, K] einsums), gather the small score vectors —
+    # projecting the gathered [NS, K, F] table would repeat all P shards'
+    # flops on every device
+    as_t = jax.lax.all_gather(jnp.einsum("nkf,kf->nk", h, a_src),
+                              PARTS_AXIS, tiled=True)   # [NS, K]
+    ad_t = jax.lax.all_gather(jnp.einsum("nkf,kf->nk", h, a_dst),
+                              PARTS_AXIS, tiled=True)   # [NS, K]
+    s = jax.nn.leaky_relu(
+        jnp.take(ad_t, ed, axis=0) + jnp.take(as_t, es, axis=0),
+        negative_slope=slope)                        # [Eb, K]
+    NEG = jnp.float32(-1e30)   # finite sentinel: see _ring_attend note
+    m_part = jax.ops.segment_max(s, ed, num_segments=NS,
+                                 indices_are_sorted=True)
+    m_part = jnp.maximum(m_part, NEG)
+    # stop_gradient BEFORE pmax: the shift carries no gradient (softmax
+    # shift invariance), and pmax has no differentiation rule anyway
+    m = jax.lax.pmax(jax.lax.stop_gradient(m_part),
+                     PARTS_AXIS)                    # [NS, K] global max
+    e = jnp.exp(s - jnp.take(m, ed, axis=0))        # [Eb, K]
+    z_part = jax.ops.segment_sum(e, ed, num_segments=NS,
+                                 indices_are_sorted=True)
+    g = jnp.take(table, es, axis=0)                 # [Eb, K, F]
+    u_part = jax.ops.segment_sum(g * e[:, :, None], ed, num_segments=NS,
+                                 indices_are_sorted=True)
+    z = jax.lax.psum_scatter(z_part, PARTS_AXIS, scatter_dimension=0,
+                             tiled=True)            # [S, K] owner rows
+    u = jax.lax.psum_scatter(u_part.reshape(NS, K * F), PARTS_AXIS,
+                             scatter_dimension=0,
+                             tiled=True).reshape(S, K, F)
+    # 1e-20, not 1e-38: subnormals flush to zero under XLA (0/0 on
+    # edgeless rows); live rows have z >= 1 by the max shift
+    return u / jnp.maximum(z, 1e-20)[:, :, None]
+
+
 def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
     """GAT attention in ring mode — LITERAL ring attention on the vertex/
     context axis (SURVEY §5.7: the vertex-shard axis IS the sequence axis).
@@ -596,8 +655,7 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
             return out
 
         def attend_edge(h, a_src, a_dst, slope):
-            raise NotImplementedError(
-                "GAT attention is not supported with -edge-shard")
+            return _edge_attend(gd_block, h, a_src, a_dst, slope)
 
         return GraphCtx(aggregate=aggregate_edge,
                         in_degree=gd_block.in_degree, attend=attend_edge)
@@ -957,8 +1015,11 @@ class SpmdTrainer(BaseTrainer):
             # an explicit -exchange ring is a deliberate distribution
             # choice; auto edge-shard must not silently override it
             return False
-        # "auto": only sum/avg aggregation is supported, and only skewed
-        # partitions benefit (the padded-max tax IS the skew cost).
+        # "auto": a perf heuristic — only skewed partitions benefit (the
+        # padded-max tax IS the skew cost).  GAT is excluded from AUTO
+        # only: _edge_attend is the correctness path (its backward
+        # scatters serialize on TPU); an explicit -edge-shard on is
+        # honored for attention models.
         if self.k > 1:        # overcommit is vertex-mode only
             return False
         aggrs = self._model_aggrs()
@@ -1034,7 +1095,8 @@ class SpmdTrainer(BaseTrainer):
         # Plan-backend attention composes with halo/allgather vertex
         # sharding, single-host or perhost.  Ring mode attends via its own
         # online-softmax recurrence (_ring_attend — no plans, no table);
-        # only -edge-shard still rejects GAT.
+        # edge mode via block scores + pmax + psum_scatter (_edge_attend,
+        # plan-less) — neither consumes gat_plans.
         gat_backend = self._gat_backend() \
             if not (self._use_edge_shard
                     or self._exchange_mode == "ring") else "xla"

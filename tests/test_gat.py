@@ -248,6 +248,27 @@ def test_gat_ring_attention_equals_single():
     assert int(m1.val_correct) == int(mr.val_correct)
 
 
+def test_gat_edge_shard_equals_single():
+    """-edge-shard + GAT (the last model x distribution cell): block-local
+    scores, pmax softmax shift, psum_scatter normalizer/output.  Must
+    train equal to the single-device and halo runs."""
+    ds, g, _ = graph_and_x(n=220)
+    layers = [ds.in_dim, 6, ds.num_classes]
+    base = dict(layers=layers, num_epochs=3, dropout_rate=0.0,
+                eval_every=10**9)
+    t1 = Trainer(Config(**base, edge_shard="off"), ds,
+                 build_gat(layers, 0.0, heads=2))
+    te = SpmdTrainer(Config(**base, num_parts=4, edge_shard=True), ds,
+                     build_gat(layers, 0.0, heads=2))
+    assert te.gdata.mode == "edge"
+    for i, rtol in enumerate((2e-5, 5e-3, 5e-3)):
+        l1, le = float(t1.run_epoch()), float(te.run_epoch())
+        np.testing.assert_allclose(le, l1, rtol=rtol, err_msg=f"epoch {i}")
+    m1 = jax.device_get(t1.evaluate())
+    me = jax.device_get(te.evaluate())
+    assert int(m1.val_correct) == int(me.val_correct)
+
+
 def test_gat_plan_perhost_equals_full_load(tmp_path):
     """Plan attention under -perhost (per-host `.lux` slice loading):
     the per-host-built, floor-padded plans must train identically to the
