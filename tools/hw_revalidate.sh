@@ -39,6 +39,11 @@ timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 10 -parts 4 -v 2>&1 | tail -2 | tee -a "$LOG"
 timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 10 -parts 4 -no-halo -v 2>&1 | tail -2 | tee -a "$LOG"
+# sharded GAT on the single chip (overcommit + plan attention) — the
+# round-2 "sharded GAT hardware perf unmeasured" gap
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-64-41 \
+    -e 10 -parts 4 -model gat -heads 2 -aggr-backend matmul -v 2>&1 \
+    | tail -2 | tee -a "$LOG"
 
 note "3. group-count sweep (fewer groups -> less phase-1 rounding)"
 for grt in 2097152 4194304 8388608; do
