@@ -108,6 +108,32 @@ def test_binned_exact_vjp():
     np.testing.assert_allclose(np.asarray(gx), ref, rtol=2e-6, atol=1e-5)
 
 
+def test_binned_exact_sharded_matches_xla():
+    """The sharded (halo) binned path must honor precision='exact': losses
+    match the single-device fp32 xla run to reassociation error, tighter
+    than the fast path's bf16 rounding could."""
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+
+    ds = datasets.synthetic("bx", 300, 5.0, 10, 4, n_train=60, n_val=60,
+                            n_test=60, seed=13)
+    layers = [10, 8, 4]
+    base = dict(layers=layers, num_epochs=3, dropout_rate=0.0,
+                eval_every=10**9)
+    t1 = Trainer(Config(**base), ds, build_gcn(layers, 0.0))
+    tb = SpmdTrainer(Config(**base, num_parts=4, halo=True,
+                            aggregate_backend="binned",
+                            aggregate_precision="exact"), ds,
+                     build_gcn(layers, 0.0))
+    assert tb.gdata.backend == "binned"
+    for i in range(3):
+        l1, lb = float(t1.run_epoch()), float(tb.run_epoch())
+        np.testing.assert_allclose(lb, l1, rtol=2e-5, err_msg=f"epoch {i}")
+
+
 def test_binned_rejects_unknown_precision():
     """Same rule as matmul_precision: a silent fallthrough to fast would
     drop the fp32-exact guarantee."""
