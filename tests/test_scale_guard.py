@@ -12,6 +12,8 @@ Two layers of guard:
 """
 
 import resource
+
+import jax
 import time
 
 import numpy as np
@@ -131,6 +133,64 @@ def test_reddit_shape_binned_plans_are_linear():
     assert _peak_rss_gb() < 60, f"absolute peak {_peak_rss_gb():.1f} GB"
     print(f"# reddit-shape binned guard: build {t_build:.0f}s "
           f"{bn_bytes/E:.1f} B/edge new-peak delta {grew:.1f} GB")
+
+
+@pytest.mark.slow
+def test_products_shape_perhost_end_to_end(tmp_path):
+    """The pod-scale data path, end to end at real scale on one host:
+    write a products-shape dataset in the on-disk format (binary feature
+    sidecar — the CSV would be tens of GB), load it with graph_stub=True
+    (12-byte header only), and train one perhost epoch on the 8-virtual-
+    device mesh: per-part `.lux` byte-range reads, local halo build with
+    allgathered floors, per-device placement, one full train step + eval.
+    This is the single-host rehearsal of the papers100M story (SURVEY §7
+    'sharded host loading')."""
+    import os
+
+    from roc_tpu.graph import datasets, lux
+    from roc_tpu.models import build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+
+    N, E, P = 2_449_029, 125_000_000, 8
+    in_dim, classes = 16, 8        # feature width scaled down: the point
+    g = _uniform_graph(N, E)       # is the graph-scale path, not the GEMMs
+    prefix = str(tmp_path / "products")
+    t0 = time.monotonic()
+    lux.write_lux(prefix + lux.LUX_SUFFIX, g)
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((N, in_dim)).astype(np.float32)
+    feats.tofile(prefix + ".feats.bin")       # binary sidecar directly
+    labels = rng.integers(0, classes, N).astype(np.int32)
+    labels.tofile(prefix + ".label.bin")
+    mask = np.full(N, lux.MASK_NONE, np.int32)
+    mask[:200_000] = lux.MASK_TRAIN
+    mask[200_000:240_000] = lux.MASK_VAL
+    with open(prefix + ".mask", "w") as f:
+        f.write("\n".join("Train" if m == lux.MASK_TRAIN else
+                          "Val" if m == lux.MASK_VAL else "None"
+                          for m in mask) + "\n")
+    t_write = time.monotonic() - t0
+
+    ds = datasets.load_roc_dataset(prefix, in_dim, classes,
+                                   graph_stub=True)
+    assert ds.graph.num_edges == E and ds.features.shape == (N, in_dim)
+    cfg = Config(layers=[in_dim, 16, classes], num_epochs=1,
+                 dropout_rate=0.0, num_parts=P, halo=True,
+                 perhost_load=True, filename=prefix, eval_every=10**9,
+                 aggregate_backend="xla", lazy_load=True)
+    t0 = time.monotonic()
+    tr = SpmdTrainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    t_setup = time.monotonic() - t0
+    t0 = time.monotonic()
+    loss = float(tr.run_epoch())
+    t_epoch = time.monotonic() - t0
+    assert np.isfinite(loss)
+    m = jax.device_get(tr.evaluate())
+    assert int(m.train_all) == 200_000
+    print(f"# products perhost e2e: write {t_write:.0f}s setup "
+          f"{t_setup:.0f}s epoch {t_epoch:.0f}s loss {loss:.1f} "
+          f"peak {_peak_rss_gb():.1f} GB")
 
 
 def test_papers100m_fits_v5p_hbm():
