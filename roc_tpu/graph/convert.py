@@ -37,6 +37,62 @@ _VENDOR_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "data")
 
 
+def read_mtx(path: str) -> "tuple[int, np.ndarray, np.ndarray, bool]":
+    """Parse a Matrix Market coordinate file (the SuiteSparse / common
+    public graph dump format): returns (num_nodes, src, dst, symmetric).
+    1-indexed entries become 0-indexed; `symmetric` headers mean the file
+    stores one triangle (caller symmetrizes via undirected=True).  Banner
+    qualifiers are case-insensitive per the MM spec."""
+    with open(path) as f:
+        header = f.readline().lower()
+        if not header.startswith("%%matrixmarket matrix coordinate"):
+            raise ValueError(f"{path}: not a MatrixMarket coordinate file "
+                             f"(header {header[:50]!r})")
+        symmetric = "symmetric" in header
+        line = f.readline()
+        while line.startswith("%"):
+            line = f.readline()
+        rows, cols, nnz = (int(v) for v in line.split()[:3])
+        n = max(rows, cols)
+        data = np.loadtxt(f, ndmin=2)
+    count = 0 if data.size == 0 else data.shape[0]
+    if count != nnz:
+        # a truncated download parses "cleanly" otherwise — silent data loss
+        raise ValueError(f"{path}: header declares {nnz} entries, file has "
+                         f"{count} (truncated?)")
+    if count == 0:
+        return n, np.zeros(0, np.int64), np.zeros(0, np.int64), symmetric
+    src = data[:, 0].astype(np.int64) - 1
+    dst = data[:, 1].astype(np.int64) - 1
+    return n, src, dst, symmetric
+
+
+def from_mtx(path: str, *, labels_path: "str | None" = None,
+             feats_path: "str | None" = None, self_edges: bool = True,
+             undirected: "bool | None" = None,
+             split: "tuple[int, int, int] | None" = None,
+             seed: int = 0, name: str = "") -> Dataset:
+    """Convert a Matrix Market graph.  ``undirected`` None follows the
+    banner (symmetric headers symmetrize); pass True to symmetrize a
+    'general'-header dump of an effectively-undirected graph."""
+    n, src, dst, symmetric = read_mtx(path)
+    feats, labels = _load_sidecars(feats_path, labels_path)
+    return _finish(name or os.path.basename(path), n, src, dst, feats,
+                   labels, None,
+                   undirected=symmetric if undirected is None else undirected,
+                   self_edges=self_edges, split=split, seed=seed)
+
+
+def _load_sidecars(feats_path, labels_path):
+    """The one place that knows the sidecar text formats (feature CSV,
+    one-int-per-line labels) — shared by every converter front end."""
+    feats = np.loadtxt(feats_path, delimiter=",", dtype=np.float32,
+                       ndmin=2) if feats_path else None
+    labels = np.loadtxt(labels_path, dtype=np.int64).reshape(-1) \
+        if labels_path else None
+    return feats, labels
+
+
 def read_edge_file(path: str) -> "tuple[np.ndarray, np.ndarray]":
     """Parse an edge-list text file: one ``src dst`` pair per line,
     whitespace- or comma-separated, ``#``-to-EOL comments, blank lines ok."""
@@ -164,13 +220,7 @@ def from_edge_list(edges_path: str, *, num_nodes: "int | None" = None,
     src, dst = read_edge_file(edges_path)
     if num_nodes is None:
         num_nodes = int(max(src.max(), dst.max())) + 1 if src.size else 0
-    feats = None
-    if feats_path:
-        feats = np.loadtxt(feats_path, delimiter=",", dtype=np.float32,
-                           ndmin=2)
-    label_ids = None
-    if labels_path:
-        label_ids = np.loadtxt(labels_path, dtype=np.int64).reshape(-1)
+    feats, label_ids = _load_sidecars(feats_path, labels_path)
     mask = None
     if mask_path:
         mask = lux.load_mask(mask_path[:-5], num_nodes) \
@@ -198,13 +248,10 @@ def from_ogb_dir(root: str, *, undirected: bool = True,
     ``undirected`` defaults to True.
     """
     src, dst = read_edge_file(os.path.join(root, "edge.csv"))
-    feats = labels = None
     fp = os.path.join(root, "node-feat.csv")
-    if os.path.exists(fp):
-        feats = np.loadtxt(fp, delimiter=",", dtype=np.float32, ndmin=2)
     lp = os.path.join(root, "node-label.csv")
-    if os.path.exists(lp):
-        labels = np.loadtxt(lp, dtype=np.int64).reshape(-1)
+    feats, labels = _load_sidecars(fp if os.path.exists(fp) else None,
+                                   lp if os.path.exists(lp) else None)
     num_nodes = (feats.shape[0] if feats is not None else
                  labels.shape[0] if labels is not None else
                  int(max(src.max(), dst.max())) + 1)

@@ -109,6 +109,28 @@ def test_ogb_dir(tmp_path):
                   lux.MASK_TEST])
 
 
+def test_mtx(tmp_path):
+    _write(tmp_path / "g.mtx",
+           "%%MatrixMarket matrix coordinate pattern symmetric\n"
+           "% a comment\n"
+           "4 4 3\n"
+           "2 1\n3 2\n4 1\n")
+    ds = convert.from_mtx(str(tmp_path / "g.mtx"))
+    assert ds.graph.num_nodes == 4
+    # 3 symmetric pairs = 6 directed + 4 self-edges
+    assert ds.graph.num_edges == 10
+    t = ds.graph.transpose()       # symmetrized: CSR == CSR^T as edge sets
+    np.testing.assert_array_equal(ds.graph.row_ptr, t.row_ptr)
+    for v in range(4):             # within-row order may differ; compare
+        sl = slice(int(ds.graph.row_ptr[v]),        # sorted multisets
+                   int(ds.graph.row_ptr[v + 1]))
+        np.testing.assert_array_equal(np.sort(ds.graph.col_idx[sl]),
+                                      np.sort(t.col_idx[sl]))
+    with pytest.raises(ValueError, match="MatrixMarket"):
+        _write(tmp_path / "bad.mtx", "not a header\n1 1 0\n")
+        convert.from_mtx(str(tmp_path / "bad.mtx"))
+
+
 def test_karate_is_the_real_graph():
     ds = convert.karate_club()
     assert ds.graph.num_nodes == 34

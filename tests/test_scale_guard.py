@@ -145,8 +145,6 @@ def test_products_shape_perhost_end_to_end(tmp_path):
     allgathered floors, per-device placement, one full train step + eval.
     This is the single-host rehearsal of the papers100M story (SURVEY §7
     'sharded host loading')."""
-    import os
-
     from roc_tpu.graph import datasets, lux
     from roc_tpu.models import build_gcn
     from roc_tpu.parallel.spmd import SpmdTrainer

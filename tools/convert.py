@@ -50,6 +50,20 @@ def main(argv=None) -> int:
                    help="keep edges directed (default symmetrizes)")
     o.add_argument("--seed", type=int, default=0)
 
+    m = sub.add_parser("mtx", help="MatrixMarket coordinate file "
+                                   "(SuiteSparse graph dumps)")
+    m.add_argument("--file", required=True)
+    m.add_argument("--labels", default=None)
+    m.add_argument("--feats", default=None)
+    m.add_argument("--undirected", action="store_true", default=None,
+                   help="symmetrize a 'general'-header dump (symmetric "
+                        "headers symmetrize automatically)")
+    m.add_argument("--no-self-edges", action="store_true")
+    m.add_argument("--split", default=None,
+                   help="TRAIN,VAL,TEST counts for a seeded stratified "
+                        "split")
+    m.add_argument("--seed", type=int, default=0)
+
     sub.add_parser("karate",
                    help="vendored real graph: Zachary's karate club")
 
@@ -69,6 +83,14 @@ def main(argv=None) -> int:
     elif a.cmd == "ogb":
         ds = convert.from_ogb_dir(a.dir, undirected=not a.directed,
                                   seed=a.seed)
+    elif a.cmd == "mtx":
+        split = tuple(int(x) for x in a.split.split(",")) if a.split else None
+        if split is not None and len(split) != 3:
+            p.error("--split wants TRAIN,VAL,TEST (three counts)")
+        ds = convert.from_mtx(a.file, labels_path=a.labels,
+                              feats_path=a.feats, undirected=a.undirected,
+                              self_edges=not a.no_self_edges, split=split,
+                              seed=a.seed)
     else:
         ds = convert.karate_club()
     convert.write(ds, a.out)
