@@ -37,7 +37,9 @@ def edge_softmax(scores, edge_dst, num_nodes: int):
     e = jnp.exp(scores - jnp.take(m, edge_dst, axis=0))
     s = jax.ops.segment_sum(e, edge_dst, num_segments=num_nodes,
                             indices_are_sorted=True)
-    return e / jnp.maximum(jnp.take(s, edge_dst, axis=0), 1e-38)
+    # 1e-20, not 1e-38: subnormal guards flush to zero under XLA (see the
+    # chunked path below); live destinations have s >= 1 by the max shift.
+    return e / jnp.maximum(jnp.take(s, edge_dst, axis=0), 1e-20)
 
 
 # GAT switches to the edge-chunked scan above the same gathered-intermediate
