@@ -52,7 +52,15 @@ def _env(name, default, cast):
 
 
 SCALE = _env("ROC_BENCH_SCALE", "1.0", float)
-NODES, IN_DIM, CLASSES = int(232_965 * SCALE), 602, 41
+# Shape overrides (round 4): ROC_BENCH_NODES / ROC_BENCH_DEG retarget the
+# synthetic graph, e.g. the ogbn-products shape of the BASELINE.json north
+# star (2,449,029 nodes, deg ~51, layers 100-256-47):
+#   ROC_BENCH_SHAPE=products ROC_BENCH_NODES=2449029 ROC_BENCH_DEG=51 \
+#   ROC_BENCH_LAYERS=100-256-47 python bench.py
+# ROC_BENCH_SHAPE only labels the metric; vs_baseline stays null off the
+# canonical reddit shape (the reference figure is a Reddit number).
+SHAPE = os.environ.get("ROC_BENCH_SHAPE", "reddit")
+NODES = int(_env("ROC_BENCH_NODES", str(232_965), int) * SCALE)
 # ROC_BENCH_MODEL=gat measures the attention path (plan backend on TPU);
 # non-gcn runs annotate the metric name and report vs_baseline null (the
 # reference figure is a GCN number).  ROC_BENCH_LAYERS overrides the hidden
@@ -62,8 +70,11 @@ MODEL = os.environ.get("ROC_BENCH_MODEL", "gcn")
 HEADS = _env("ROC_BENCH_HEADS", "4", int)
 _layers_env = os.environ.get("ROC_BENCH_LAYERS", "")
 LAYERS = [int(v) for v in _layers_env.split("-")] if _layers_env \
-    else [IN_DIM, 256, CLASSES]
-AVG_DEG = 50.0
+    else [602, 256, 41]
+# The synthetic graph's feature/class dims follow the layer spec (the
+# driver asserts they agree).
+IN_DIM, CLASSES = LAYERS[0], LAYERS[-1]
+AVG_DEG = _env("ROC_BENCH_DEG", "50.0", float)
 WARMUP = 3
 MEASURED = _env("ROC_BENCH_EPOCHS", "10", int)
 BACKEND = os.environ.get("ROC_BENCH_BACKEND", "auto")
@@ -71,11 +82,22 @@ BACKEND = os.environ.get("ROC_BENCH_BACKEND", "auto")
 # one-hot dots; golden-curve-validated, docs/GOLDEN.md).  Overriding to
 # exact annotates the metric name so histories are never conflated.
 PRECISION = os.environ.get("ROC_BENCH_PRECISION", "fast")
-METRIC = (f"{MODEL}_reddit{'-'.join(map(str, LAYERS))}"
+# ROC_BENCH_REORDER=1: RCM locality pass before training (graph/reorder.py)
+# — annotates the metric; the canonical number stays unreordered.
+REORDER = _env("ROC_BENCH_REORDER", "0", int) != 0
+# The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
+# the unmodified Reddit shape; shape overrides annotate the metric name so
+# histories are never conflated.
+CANONICAL_SHAPE = (SHAPE == "reddit"
+                   and "ROC_BENCH_NODES" not in os.environ
+                   and "ROC_BENCH_DEG" not in os.environ
+                   and LAYERS == [602, 256, 41])
+METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + (f"_heads{HEADS}" if MODEL == "gat" else "")
           + "_epoch_time"
           + ("" if SCALE == 1.0 else f"_scale{SCALE:g}")
-          + ("" if PRECISION == "fast" else f"_{PRECISION}"))
+          + ("" if PRECISION == "fast" else f"_{PRECISION}")
+          + ("_reorder" if REORDER else ""))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -233,10 +255,15 @@ def _cached_dataset():
     # v1: bump when datasets.synthetic's construction or defaults
     # (p_intra=0.8, feature_snr=1.0) change — the key must cover every
     # input that shapes the generated data.
+    if CANONICAL_SHAPE:
+        splits = dict(n_train=int(153431 * SCALE),
+                      n_val=int(23831 * SCALE), n_test=int(55703 * SCALE))
+    else:   # overridden shapes: proportional masks (timing-irrelevant)
+        splits = dict(n_train=int(NODES * 0.6), n_val=int(NODES * 0.1),
+                      n_test=int(NODES * 0.2))
     args = dict(gen="synthetic-v1", p_intra=0.8, feature_snr=1.0,
                 num_nodes=NODES, avg_degree=AVG_DEG, in_dim=IN_DIM,
-                num_classes=CLASSES, n_train=int(153431 * SCALE),
-                n_val=int(23831 * SCALE), n_test=int(55703 * SCALE), seed=1)
+                num_classes=CLASSES, seed=1, **splits)
     key = "_".join(f"{k}={v}" for k, v in sorted(args.items()))
     digest = hashlib.sha1(key.encode()).hexdigest()[:12]
     path = f"/tmp/roc_bench_{digest}.npz"
@@ -248,12 +275,12 @@ def _cached_dataset():
                         num_edges=int(z["col_idx"].shape[0]),
                         row_ptr=z["row_ptr"], col_idx=z["col_idx"])
                 return datasets.Dataset(
-                    name="reddit-bench", graph=g, features=z["features"],
+                    name=f"{SHAPE}-bench", graph=g, features=z["features"],
                     labels=None, label_ids=z["label_ids"], mask=z["mask"],
                     in_dim=IN_DIM, num_classes=CLASSES)
     except Exception:            # corrupt/missing cache: regenerate
         pass
-    ds = datasets.synthetic("reddit-bench", NODES, AVG_DEG, IN_DIM, CLASSES,
+    ds = datasets.synthetic(f"{SHAPE}-bench", NODES, AVG_DEG, IN_DIM, CLASSES,
                             n_train=args["n_train"], n_val=args["n_val"],
                             n_test=args["n_test"], seed=1)
     try:
@@ -290,6 +317,12 @@ def run():
     print(f"# graph ready: {ds.graph.num_nodes} nodes "
           f"{ds.graph.num_edges} edges ({time.time()-t0:.1f}s)",
           file=sys.stderr)
+    if REORDER:
+        from roc_tpu.graph.reorder import reorder_dataset
+        t0 = time.time()
+        ds, _ = reorder_dataset(ds)
+        print(f"# RCM locality reorder applied ({time.time()-t0:.1f}s)",
+              file=sys.stderr)
 
     def build_and_warm(backend):
         cfg = Config(layers=LAYERS, num_epochs=1, learning_rate=0.01,
@@ -366,7 +399,7 @@ def run():
         "unit": "s",
         # the reference figure is a GCN number; other models report null
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
-        if MODEL == "gcn" else None,
+        if MODEL == "gcn" and CANONICAL_SHAPE else None,
         "backend": resolved,                   # what auto resolved to
         "platform": jax.default_backend(),
         "edges_per_sec_per_chip": round(edges_per_sec_per_chip),
@@ -378,6 +411,7 @@ def run():
         result["fallback"] = f"auto failed ({fallback_from}); ran {fb}"
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
+            and CANONICAL_SHAPE and not REORDER
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

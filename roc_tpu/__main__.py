@@ -74,6 +74,18 @@ def main(argv=None) -> int:
         print("error: one of -file or -dataset is required", file=sys.stderr)
         return 2
 
+    if cfg.reorder:
+        import time as _time
+
+        from roc_tpu.graph.reorder import reorder_dataset
+        assert not cfg.perhost_load, \
+            "-reorder needs the whole graph in memory; incompatible with " \
+            "-perhost (preprocess the dataset offline instead)"
+        t0 = _time.time()
+        ds, _ = reorder_dataset(ds)
+        print(f"# RCM locality reorder: {ds.graph.num_nodes} nodes in "
+              f"{_time.time() - t0:.1f}s", file=sys.stderr)
+
     model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
                         heads=cfg.heads)
 

@@ -98,10 +98,29 @@ def _bind(L: ctypes.CDLL) -> None:
     L.roc_binned_plan_fill.argtypes = [i64p, i64p] + \
         [ctypes.c_int64] * 7 + [i32p] * 6
     L.roc_binned_plan_fill.restype = ctypes.c_int
+    L.roc_binned_plan_sizes_g.argtypes = [i64p, i64p, i64p] + \
+        [ctypes.c_int64] * 4 + [i64p]
+    L.roc_binned_plan_sizes_g.restype = ctypes.c_int
+    L.roc_binned_plan_fill_g.argtypes = [i64p, i64p, i64p] + \
+        [ctypes.c_int64] * 7 + [i32p] * 6
+    L.roc_binned_plan_fill_g.restype = ctypes.c_int
 
 
 def available() -> bool:
     return lib() is not None
+
+
+def binned_geometry():
+    """The default (sb, ch, slot, rb, ch2) compiled into the library, or
+    None when it is unavailable.  Informational only since the builder
+    became geometry-parametric (roc_binned_plan_*_g take the geometry as
+    arguments); kept because the C symbol is part of the ABI."""
+    L = lib()
+    if L is None:
+        return None
+    geo = np.zeros(5, np.int64)
+    L.roc_binned_geometry(geo)
+    return tuple(int(v) for v in geo)
 
 
 # -- typed wrappers ---------------------------------------------------------
@@ -234,27 +253,28 @@ def chunk_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int):
 
 
 def binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int,
-                table_rows: int, group_row_target: int):
+                table_rows: int, group_row_target: int, geom=None):
     """Binned aggregation schedule (see binned.build_binned_plan).
 
     Returns (p1_srcl [G,C1*CH], p1_off [G,C1,NSLOT], p1_blk [G,C1],
     p2_dstl [G,C2*CH2], p2_obi [G,C2], p2_first [G,C2], bins_per_group) —
-    int32 arrays matching the pure-NumPy builder bit for bit.  The C++
-    side exports its compiled-in geometry; we assert agreement first."""
+    int32 arrays matching the pure-NumPy builder bit for bit.  ``geom`` is
+    a binned.Geometry (None = the Python-side default constants); the C++
+    builder takes it as arguments (roc_binned_plan_*_g), so the
+    sparse-graph presets get the O(E) native build too."""
     L = lib()
     assert L is not None
-    from roc_tpu.ops.pallas.binned import CH, CH2, NSLOT, RB, SB, SLOT
-    geo = np.zeros(5, np.int64)
-    L.roc_binned_geometry(geo)
-    assert tuple(geo) == (SB, CH, SLOT, RB, CH2), (
-        f"native binned geometry {tuple(geo)} != python "
-        f"({SB}, {CH}, {SLOT}, {RB}, {CH2}); rebuild roc_tpu/native")
+    if geom is None:
+        from roc_tpu.ops.pallas.binned import _default_geom
+        geom = _default_geom()
+    CH, CH2, NSLOT = geom.ch, geom.ch2, geom.nslot
+    geo5 = np.asarray(tuple(geom), np.int64)
     src = np.ascontiguousarray(edge_src, np.int64)
     dst = np.ascontiguousarray(edge_dst, np.int64)
     E = len(src)
     out4 = np.zeros(4, np.int64)
-    rc = L.roc_binned_plan_sizes(src, dst, E, num_rows, table_rows,
-                                 group_row_target, out4)
+    rc = L.roc_binned_plan_sizes_g(geo5, src, dst, E, num_rows, table_rows,
+                                   group_row_target, out4)
     if rc != 0:
         raise RuntimeError(f"roc_binned_plan_sizes rc={rc}")
     G, C1, C2, bpg = (int(v) for v in out4)
@@ -264,9 +284,9 @@ def binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int,
     p2_dstl = np.empty(G * C2 * CH2, np.int32)
     p2_obi = np.empty(G * C2, np.int32)
     p2_first = np.empty(G * C2, np.int32)
-    rc = L.roc_binned_plan_fill(src, dst, E, num_rows, table_rows,
-                                group_row_target, G, C1, C2, p1_srcl,
-                                p1_off, p1_blk, p2_dstl, p2_obi, p2_first)
+    rc = L.roc_binned_plan_fill_g(geo5, src, dst, E, num_rows, table_rows,
+                                  group_row_target, G, C1, C2, p1_srcl,
+                                  p1_off, p1_blk, p2_dstl, p2_obi, p2_first)
     if rc != 0:
         raise RuntimeError(f"roc_binned_plan_fill rc={rc}")
     return (p1_srcl.reshape(G, C1 * CH), p1_off.reshape(G, C1, NSLOT),

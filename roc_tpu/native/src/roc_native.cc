@@ -359,21 +359,42 @@ int roc_halo_fill(const int64_t* edge_src, int64_t P, int64_t E, int64_t S,
 
 static const int64_t BN_SB = 512, BN_CH = 2048, BN_SLOT = 128;
 static const int64_t BN_RB = 512, BN_CH2 = 4096;
-static const int64_t BN_NSLOT = BN_CH / BN_SLOT;     // 16
-static const int64_t BN_SLOT2 = BN_CH2 / BN_SLOT;    // 32
 static const int64_t BN_K2_CAP = (int64_t)1 << 25;   // binned.py _K2_CAP
 
-void roc_binned_geometry(int64_t* out5) {
-  out5[0] = BN_SB; out5[1] = BN_CH; out5[2] = BN_SLOT;
-  out5[3] = BN_RB; out5[4] = BN_CH2;
+// Runtime geometry (round 4): the builder takes (sb, ch, slot, rb, ch2) as
+// arguments so the sparse-graph presets (binned.py GEOM_MID/GEOM_SPARSE)
+// get the O(E) native build too.  The BN_* constants above remain the
+// default exported by roc_binned_geometry (compat with older callers).
+struct BnGeo {
+  int64_t sb, ch, slot, rb, ch2, nslot, slot2;
+};
+
+static int bn_geo_from(const int64_t* geo5, BnGeo* g) {
+  g->sb = geo5[0]; g->ch = geo5[1]; g->slot = geo5[2];
+  g->rb = geo5[3]; g->ch2 = geo5[4];
+  if (g->sb < 1 || g->rb < 1 || g->slot < 1) return -1;
+  // ch/ch2 below slot would make nslot/slot2 zero and the chunk-count
+  // divisions SIGFPE — reject instead
+  if (g->ch < g->slot || g->ch % g->slot) return -1;
+  if (g->ch2 < g->slot || g->ch2 % g->slot) return -1;
+  g->nslot = g->ch / g->slot;
+  g->slot2 = g->ch2 / g->slot;
+  return 0;
 }
 
-static void bn_params(int64_t E, int64_t num_rows, int64_t table_rows,
-                      int64_t group_row_target, int64_t* num_bins,
-                      int64_t* num_blocks, int64_t* bpg, int64_t* G) {
-  *num_bins = (num_rows + BN_RB - 1) / BN_RB;
+static const int64_t BN_DEFAULT5[5] = {BN_SB, BN_CH, BN_SLOT, BN_RB, BN_CH2};
+
+void roc_binned_geometry(int64_t* out5) {
+  for (int i = 0; i < 5; i++) out5[i] = BN_DEFAULT5[i];
+}
+
+static void bn_params(const BnGeo& geo, int64_t E, int64_t num_rows,
+                      int64_t table_rows, int64_t group_row_target,
+                      int64_t* num_bins, int64_t* num_blocks, int64_t* bpg,
+                      int64_t* G) {
+  *num_bins = (num_rows + geo.rb - 1) / geo.rb;
   if (*num_bins < 1) *num_bins = 1;
-  *num_blocks = (table_rows + BN_SB - 1) / BN_SB;
+  *num_blocks = (table_rows + geo.sb - 1) / geo.sb;
   if (*num_blocks < 1) *num_blocks = 1;
   double per_bin = (double)E / (double)*num_bins;
   if (per_bin < 1.0) per_bin = 1.0;
@@ -388,7 +409,7 @@ static void bn_params(int64_t E, int64_t num_rows, int64_t table_rows,
 // Shared walk: buckets edges, computes per-group geometry, and (when fill
 // buffers are non-null) writes every plan array.  Returns 0, or -1 when the
 // caller-passed C1/C2 disagree with the recomputed geometry.
-static int bn_build(const int64_t* src, const int64_t* dst, int64_t E,
+static int bn_build(const BnGeo& geo, const int64_t* src, const int64_t* dst, int64_t E,
                     int64_t num_rows, int64_t table_rows,
                     int64_t group_row_target,
                     int64_t* out_G, int64_t* out_C1, int64_t* out_C2,
@@ -397,10 +418,10 @@ static int bn_build(const int64_t* src, const int64_t* dst, int64_t E,
                     int32_t* p1_srcl, int32_t* p1_off, int32_t* p1_blk,
                     int32_t* p2_dstl, int32_t* p2_obi, int32_t* p2_first) {
   int64_t num_bins, num_blocks, bpg, G;
-  bn_params(E, num_rows, table_rows, group_row_target,
+  bn_params(geo, E, num_rows, table_rows, group_row_target,
             &num_bins, &num_blocks, &bpg, &G);
   const bool fill = p1_srcl != nullptr;
-  const int64_t rows_pg = BN_RB * bpg;
+  const int64_t rows_pg = geo.rb * bpg;
 
   // Pass 0: bucket edge (src, dst) VALUES by group (stable).  Buckets hold
   // values, not edge ids — every later pass then reads sequentially
@@ -431,28 +452,28 @@ static int bn_build(const int64_t* src, const int64_t* dst, int64_t E,
     if (g > 0) {
       const int64_t plo = gcnt[g - 1], phi = gcnt[g];
       for (int64_t i = plo; i < phi; i++)
-        ccnt[(gsrc[i] / BN_SB) * bpg
-             + (gdst[i] / BN_RB - (g - 1) * bpg)] = 0;
+        ccnt[(gsrc[i] / geo.sb) * bpg
+             + (gdst[i] / geo.rb - (g - 1) * bpg)] = 0;
     }
     for (int64_t i = lo; i < hi; i++)
-      ccnt[(gsrc[i] / BN_SB) * bpg + (gdst[i] / BN_RB - g * bpg)]++;
+      ccnt[(gsrc[i] / geo.sb) * bpg + (gdst[i] / geo.rb - g * bpg)]++;
     // Geometry: per-block and per-bin slot totals -> chunk bases.
     std::fill(blk_slots.begin(), blk_slots.end(), 0);
     std::fill(bin_slots.begin(), bin_slots.end(), 0);
     for (int64_t k = 0; k < K2; k++) {
       if (!ccnt[k]) continue;
-      const int64_t slots = (ccnt[k] + BN_SLOT - 1) / BN_SLOT;
+      const int64_t slots = (ccnt[k] + geo.slot - 1) / geo.slot;
       blk_slots[k / bpg] += slots;
       bin_slots[k % bpg] += slots;
     }
     int64_t c1 = 0, c2 = 0;
     for (int64_t b = 0; b < num_blocks; b++) {
       blk_cbase[b] = c1;
-      c1 += (blk_slots[b] + BN_NSLOT - 1) / BN_NSLOT;
+      c1 += (blk_slots[b] + geo.nslot - 1) / geo.nslot;
     }
     for (int64_t b = 0; b < bpg; b++) {
       bin_cbase[b] = c2;
-      int64_t ch = (bin_slots[b] + BN_SLOT2 - 1) / BN_SLOT2;
+      int64_t ch = (bin_slots[b] + geo.slot2 - 1) / geo.slot2;
       c2 += ch < 1 ? 1 : ch;
     }
     if (c1 > maxC1) maxC1 = c1;
@@ -465,16 +486,16 @@ static int bn_build(const int64_t* src, const int64_t* dst, int64_t E,
     for (int64_t k = 1; k < K2; k++) cbase[k] = cbase[k - 1] + ccnt[k - 1];
     std::copy(cbase.begin(), cbase.end(), pos.begin());
     for (int64_t i = lo; i < hi; i++) {
-      const int64_t p = lo + pos[(gsrc[i] / BN_SB) * bpg
-                                 + (gdst[i] / BN_RB - g * bpg)]++;
+      const int64_t p = lo + pos[(gsrc[i] / geo.sb) * bpg
+                                 + (gdst[i] / geo.rb - g * bpg)]++;
       csrc[p] = gsrc[i];
       cdst[p] = gdst[i];
     }
     // Fill: walk cells in (blk, lbin) order.
-    int32_t* srcl = p1_srcl + g * C1 * BN_CH;
-    int32_t* offp = p1_off + g * C1 * BN_NSLOT;
+    int32_t* srcl = p1_srcl + g * C1 * geo.ch;
+    int32_t* offp = p1_off + g * C1 * geo.nslot;
     int32_t* blkp = p1_blk + g * C1;
-    int32_t* dstl = p2_dstl + g * C2 * BN_CH2;
+    int32_t* dstl = p2_dstl + g * C2 * geo.ch2;
     std::fill(bin_off.begin(), bin_off.end(), 0);
     int64_t blk_slot_run = 0, cur_blk = -1;
     for (int64_t k = 0; k < K2; k++) {
@@ -482,31 +503,31 @@ static int bn_build(const int64_t* src, const int64_t* dst, int64_t E,
       if (!cnt) continue;
       const int64_t blk = k / bpg, lbin = k % bpg;
       if (blk != cur_blk) { cur_blk = blk; blk_slot_run = 0; }
-      const int64_t slots = (cnt + BN_SLOT - 1) / BN_SLOT;
-      const int64_t stg_slot = bin_cbase[lbin] * BN_SLOT2 + bin_off[lbin];
-      const int64_t p1_slot = blk_cbase[blk] * BN_NSLOT + blk_slot_run;
+      const int64_t slots = (cnt + geo.slot - 1) / geo.slot;
+      const int64_t stg_slot = bin_cbase[lbin] * geo.slot2 + bin_off[lbin];
+      const int64_t p1_slot = blk_cbase[blk] * geo.nslot + blk_slot_run;
       for (int64_t kk = 0; kk < slots; kk++)
         offp[p1_slot + kk] = (int32_t)(stg_slot + kk);
-      const int64_t p1_row = p1_slot * BN_SLOT;
-      const int64_t stg_row = stg_slot * BN_SLOT;
+      const int64_t p1_row = p1_slot * geo.slot;
+      const int64_t stg_row = stg_slot * geo.slot;
       const int64_t cello = lo + cbase[k];
       for (int64_t r = 0; r < cnt; r++) {
-        srcl[p1_row + r] = (int32_t)(csrc[cello + r] - blk * BN_SB);
+        srcl[p1_row + r] = (int32_t)(csrc[cello + r] - blk * geo.sb);
         dstl[stg_row + r] = (int32_t)(cdst[cello + r]
-                                      - (g * bpg + lbin) * BN_RB);
+                                      - (g * bpg + lbin) * geo.rb);
       }
       bin_off[lbin] += slots;
       blk_slot_run += slots;
     }
     for (int64_t b = 0; b < num_blocks; b++) {
-      const int64_t n = (blk_slots[b] + BN_NSLOT - 1) / BN_NSLOT;
+      const int64_t n = (blk_slots[b] + geo.nslot - 1) / geo.nslot;
       for (int64_t j = 0; j < n; j++) blkp[blk_cbase[b] + j] = (int32_t)b;
     }
     int32_t* obi = p2_obi + g * C2;
     int32_t* first = p2_first + g * C2;
     int64_t c = 0;
     for (int64_t b = 0; b < bpg; b++) {
-      int64_t ch = (bin_slots[b] + BN_SLOT2 - 1) / BN_SLOT2;
+      int64_t ch = (bin_slots[b] + geo.slot2 - 1) / geo.slot2;
       if (ch < 1) ch = 1;
       for (int64_t j = 0; j < ch; j++, c++) {
         obi[c] = (int32_t)b;
@@ -522,10 +543,15 @@ static int bn_build(const int64_t* src, const int64_t* dst, int64_t E,
   return 0;
 }
 
-int roc_binned_plan_sizes(const int64_t* src, const int64_t* dst, int64_t E,
-                          int64_t num_rows, int64_t table_rows,
-                          int64_t group_row_target, int64_t* out4) {
-  return bn_build(src, dst, E, num_rows, table_rows, group_row_target,
+// Geometry-parametric entry points (round 4): geo5 = (sb, ch, slot, rb,
+// ch2).  Returns -2 on invalid geometry.
+int roc_binned_plan_sizes_g(const int64_t* geo5, const int64_t* src,
+                            const int64_t* dst, int64_t E, int64_t num_rows,
+                            int64_t table_rows, int64_t group_row_target,
+                            int64_t* out4) {
+  BnGeo geo;
+  if (bn_geo_from(geo5, &geo) != 0) return -2;
+  return bn_build(geo, src, dst, E, num_rows, table_rows, group_row_target,
                   &out4[0], &out4[1], &out4[2], &out4[3],
                   0, 0, nullptr, nullptr, nullptr, nullptr, nullptr,
                   nullptr);
@@ -533,25 +559,48 @@ int roc_binned_plan_sizes(const int64_t* src, const int64_t* dst, int64_t E,
 
 // Caller allocates: p1_srcl [G*C1*CH], p1_off [G*C1*NSLOT] (pre-filled by
 // this call: unused slots get -1), p1_blk [G*C1], p2_dstl [G*C2*CH2],
-// p2_obi [G*C2], p2_first [G*C2].  Returns 0, -1 on geometry mismatch.
+// p2_obi [G*C2], p2_first [G*C2].  Returns 0, -1 on geometry mismatch,
+// -2 on invalid geometry.
+int roc_binned_plan_fill_g(const int64_t* geo5, const int64_t* src,
+                           const int64_t* dst, int64_t E, int64_t num_rows,
+                           int64_t table_rows, int64_t group_row_target,
+                           int64_t G, int64_t C1, int64_t C2,
+                           int32_t* p1_srcl, int32_t* p1_off,
+                           int32_t* p1_blk, int32_t* p2_dstl,
+                           int32_t* p2_obi, int32_t* p2_first) {
+  BnGeo geo;
+  if (bn_geo_from(geo5, &geo) != 0) return -2;
+  std::fill(p1_srcl, p1_srcl + G * C1 * geo.ch, 0);
+  std::fill(p1_off, p1_off + G * C1 * geo.nslot, -1);
+  std::fill(p1_blk, p1_blk + G * C1, 0);
+  std::fill(p2_dstl, p2_dstl + G * C2 * geo.ch2, (int32_t)geo.rb);
+  std::fill(p2_obi, p2_obi + G * C2, 0);
+  std::fill(p2_first, p2_first + G * C2, 0);
+  int64_t g2, c1, c2, bpg;
+  int rc = bn_build(geo, src, dst, E, num_rows, table_rows,
+                    group_row_target, &g2, &c1, &c2, &bpg, C1, C2, p1_srcl,
+                    p1_off, p1_blk, p2_dstl, p2_obi, p2_first);
+  if (rc != 0 || g2 != G || c1 > C1 || c2 > C2) return -1;
+  return 0;
+}
+
+int roc_binned_plan_sizes(const int64_t* src, const int64_t* dst, int64_t E,
+                          int64_t num_rows, int64_t table_rows,
+                          int64_t group_row_target, int64_t* out4) {
+  return roc_binned_plan_sizes_g(BN_DEFAULT5, src, dst, E, num_rows,
+                                 table_rows, group_row_target, out4);
+}
+
 int roc_binned_plan_fill(const int64_t* src, const int64_t* dst, int64_t E,
                          int64_t num_rows, int64_t table_rows,
                          int64_t group_row_target, int64_t G, int64_t C1,
                          int64_t C2, int32_t* p1_srcl, int32_t* p1_off,
                          int32_t* p1_blk, int32_t* p2_dstl, int32_t* p2_obi,
                          int32_t* p2_first) {
-  std::fill(p1_srcl, p1_srcl + G * C1 * BN_CH, 0);
-  std::fill(p1_off, p1_off + G * C1 * BN_NSLOT, -1);
-  std::fill(p1_blk, p1_blk + G * C1, 0);
-  std::fill(p2_dstl, p2_dstl + G * C2 * BN_CH2, (int32_t)BN_RB);
-  std::fill(p2_obi, p2_obi + G * C2, 0);
-  std::fill(p2_first, p2_first + G * C2, 0);
-  int64_t g2, c1, c2, bpg;
-  int rc = bn_build(src, dst, E, num_rows, table_rows, group_row_target,
-                    &g2, &c1, &c2, &bpg, C1, C2, p1_srcl, p1_off, p1_blk,
-                    p2_dstl, p2_obi, p2_first);
-  if (rc != 0 || g2 != G || c1 > C1 || c2 > C2) return -1;
-  return 0;
+  return roc_binned_plan_fill_g(BN_DEFAULT5, src, dst, E, num_rows,
+                                table_rows, group_row_target, G, C1, C2,
+                                p1_srcl, p1_off, p1_blk, p2_dstl, p2_obi,
+                                p2_first);
 }
 
 void roc_in_degrees(const uint64_t* raw_rows, uint64_t num_nodes,

@@ -311,14 +311,37 @@ class BinnedPlans(NamedTuple):
 
 
 def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
-                       num_rows: int, table_rows: int) -> BinnedPlans:
+                       num_rows: int, table_rows: int,
+                       geom=None) -> BinnedPlans:
     """Schedules for out = A@x (fwd) and grad_x = A^T@grad (bwd) — the bwd
     plan swaps roles exactly as the reference re-launches its forward
-    kernel transposed (scattergather_kernel.cu:160-170)."""
-    from roc_tpu.ops.pallas.binned import build_binned_plan
+    kernel transposed (scattergather_kernel.cu:160-170).
+
+    geom: None = the module-default geometry; a Geometry = both directions
+    at that geometry; "auto" = per-direction choose_geometry from actual
+    cell statistics (the directions transpose, so a directed graph can
+    legitimately want different windows each way), falling back to the
+    default where the model prefers matmul (the caller already chose
+    binned).  A (fwd_spec, bwd_spec) pair sets each direction separately —
+    resolve_backend_geom threads its already-chosen forward Geometry this
+    way so the O(E) statistics aren't recomputed."""
+    from roc_tpu.ops.pallas.binned import (_default_geom, build_binned_plan,
+                                           choose_geometry)
+    fwd_spec, bwd_spec = geom if isinstance(geom, tuple) else (geom, geom)
+
+    def pick(spec, src, dst, n, t):
+        if spec != "auto":
+            return spec
+        g, _ = choose_geometry(src, dst, n, t, force=True)
+        return g or _default_geom()
+
     return BinnedPlans(
-        fwd=build_binned_plan(edge_src, edge_dst, num_rows, table_rows),
-        bwd=build_binned_plan(edge_dst, edge_src, table_rows, num_rows))
+        fwd=build_binned_plan(edge_src, edge_dst, num_rows, table_rows,
+                              geom=pick(fwd_spec, edge_src, edge_dst,
+                                        num_rows, table_rows)),
+        bwd=build_binned_plan(edge_dst, edge_src, table_rows, num_rows,
+                              geom=pick(bwd_spec, edge_dst, edge_src,
+                                        table_rows, num_rows)))
 
 
 def matmul_precision(aggregate_precision: str) -> str:
@@ -346,7 +369,7 @@ def pad_binned_plans(plans: "list[BinnedPlans]", min_fwd=(0, 0),
     def stack(side, floors):
         ps = [getattr(b, side) for b in plans]
         meta = {(p.num_rows, p.table_rows, p.bins_per_group,
-                 p.p1_blk.shape[0]) for p in ps}
+                 p.p1_blk.shape[0], p.geom) for p in ps}
         assert len(meta) == 1, f"shards disagree on plan geometry: {meta}"
         C1 = max(max(p.p1_blk.shape[1] for p in ps), floors[0])
         C2 = max(max(p.p2_obi.shape[1] for p in ps), floors[1])

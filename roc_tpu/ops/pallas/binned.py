@@ -83,6 +83,65 @@ CH2 = 4096    # staging rows per phase-2 chunk
 NSLOT = CH // SLOT
 SLOT2 = CH2 // SLOT   # slots per phase-2 chunk
 
+
+from typing import NamedTuple
+
+
+class Geometry(NamedTuple):
+    """One binned-schedule geometry: every constant the plan builders and
+    kernels share.  Carried on the plan (static meta), so plans built with
+    different geometries coexist in one process — the sparse-graph presets
+    below are how products-density graphs get a binned fast path at all
+    (VERDICT r3 item 3: the dense geometry's slot padding is ~5-20x there).
+
+    Invariants (asserted at use): slot divides ch and ch2; slot is a
+    multiple of 16 (bf16 sublane granularity of the staging slot DMAs);
+    VMEM budget ~16 MB/core bounds ch*sb (phase-1 one-hot), ch2*rb
+    (phase-2 one-hot) and the rb*H resident window."""
+    sb: int       # source rows per x block (phase-1 streaming unit)
+    ch: int       # edge slots per phase-1 chunk
+    slot: int     # staging write granularity, rows
+    rb: int       # destination rows per bin (phase-2 resident window)
+    ch2: int      # staging rows per phase-2 chunk
+
+    @property
+    def nslot(self) -> int:
+        return self.ch // self.slot
+
+    @property
+    def slot2(self) -> int:
+        return self.ch2 // self.slot
+
+    def check(self) -> "Geometry":
+        assert self.sb >= 1 and self.rb >= 1, self
+        assert self.slot >= 16 and self.slot % 16 == 0, \
+            f"slot must be a positive multiple of 16: {self}"
+        assert self.ch >= self.slot and self.ch % self.slot == 0, self
+        assert self.ch2 >= self.slot and self.ch2 % self.slot == 0, self
+        return self
+
+
+def _default_geom() -> Geometry:
+    """The module constants above remain the source of truth for the
+    default geometry (tools/sweep_binned.py monkeypatches them; the env
+    knobs there must keep steering everything that doesn't pass an
+    explicit geometry)."""
+    return Geometry(SB, CH, SLOT, RB, CH2)
+
+
+# Presets for sparser graphs than the (dense, Reddit-like) default serves.
+# The padding tax of a geometry is cells_touched * slot / E; sparser graphs
+# touch more cells per edge, so slot shrinks and (to keep the cell count
+# down) the windows grow.  Larger windows cost more one-hot MACs per edge
+# ((sb + rb) * H), which is why these are not the default: choose_geometry
+# picks per graph from ACTUAL plan statistics.
+# VMEM at H<=512 (fp32 worst case, ~16 MB/core budget):
+#   mid    = dense windows, slot 32:  same footprint as the default.
+#   sparse = 1024/2048-row windows:  p1 one-hot (2048x1024 bf16) 4 MB +
+#            gbuf 2x2048xH, p2 one-hot (2048x1024 bf16) 4 MB + rb*H out.
+GEOM_MID = Geometry(sb=512, ch=2048, slot=32, rb=512, ch2=4096)
+GEOM_SPARSE = Geometry(sb=1024, ch=2048, slot=16, rb=1024, ch2=2048)
+
 # Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).  Fewer
 # groups = less per-(group, block) chunk-rounding padding in phase 1 at the
 # cost of a proportionally larger staging buffer; ROC_BINNED_GROUP_ROWS
@@ -116,13 +175,16 @@ class BinnedPlan:
     table_rows: int = dataclasses.field(metadata={"static": True}, default=0)
     bins_per_group: int = dataclasses.field(
         metadata={"static": True}, default=0)
+    # The geometry the plan was built for; the kernels replay it (static).
+    geom: Geometry = dataclasses.field(metadata={"static": True},
+                                       default=None)
 
 
 jax.tree_util.register_dataclass(
     BinnedPlan,
     data_fields=["p1_srcl", "p1_off", "p1_blk",
                  "p2_dstl", "p2_obi", "p2_first"],
-    meta_fields=["num_rows", "table_rows", "bins_per_group"])
+    meta_fields=["num_rows", "table_rows", "bins_per_group", "geom"])
 
 
 def _pad_to(n: int, m: int) -> int:
@@ -147,6 +209,91 @@ def binned_viable(num_rows: int, table_rows: int, num_edges: int) -> bool:
     return num_blocks * num_bins * SLOT * 4 <= num_edges * 5
 
 
+# Cost-model calibration, measured on v5e at Reddit shape (docs/PERF.md,
+# 2026-07-31): both phases are per-grid-step-overhead-bound at ~10/12 us
+# per chunk, with the one-hot MACs sustaining ~35-44% of the 197 TF/s bf16
+# peak when they dominate.  t_phase = max(MAC time, chunk overhead); the
+# matmul backend's cost is its issue-rate-bound row gather (~10 ns/row,
+# H-independent up to ~128 lanes) plus its cheap VB=8 one-hot dots —
+# calibrated end to end: 23.5M edges -> 351 ms measured = 15 ns/edge.
+_MXU_EFF_FLOPS = 69e12        # 35% of v5e bf16 peak (phase-1 measured)
+_CHUNK_OVERHEAD_S = 11e-6     # per grid step (9.6-12.2 us measured)
+_MATMUL_NS_PER_EDGE = 15.0
+_MODEL_H = 256                # nominal width: plans are H-independent
+
+
+def _binned_cost_model(padded_rows: int, geom: Geometry,
+                       H: int = _MODEL_H) -> float:
+    """Modeled seconds for ONE aggregation pass at this geometry, given the
+    actual slot-padded staging row count (from cell statistics)."""
+    mac1 = padded_rows * geom.sb * H * 2 / _MXU_EFF_FLOPS
+    mac2 = padded_rows * geom.rb * H * 2 / _MXU_EFF_FLOPS
+    ov1 = padded_rows / geom.ch * _CHUNK_OVERHEAD_S
+    ov2 = padded_rows / geom.ch2 * _CHUNK_OVERHEAD_S
+    return max(mac1, ov1) + max(mac2, ov2)
+
+
+def _cell_counts(edge_src: np.ndarray, edge_dst: np.ndarray,
+                 sb: int, rb: int) -> np.ndarray:
+    """Nonzero (source-block x destination-bin) cell occupancies — one
+    O(E) bincount, the single implementation every occupancy consumer
+    shares (cell key = block * nbins + bin)."""
+    blk = np.asarray(edge_src, np.int64) // sb
+    bn = np.asarray(edge_dst, np.int64) // rb
+    nbins = int(bn.max(initial=0)) + 1
+    cnt = np.bincount(blk * nbins + bn)
+    return cnt[cnt > 0]
+
+
+def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,
+                    geom: Geometry) -> int:
+    """ACTUAL slot-padded staging rows for this graph at this geometry:
+    every touched (source-block x destination-bin) cell rounds up to whole
+    SLOTs.  No uniform-graph assumption, so a locality-preserving vertex
+    order (the greedy-cut partitioner's output) is credited for the cells
+    it never touches."""
+    cnt = _cell_counts(edge_src, edge_dst, geom.sb, geom.rb)
+    return int((-(-cnt // geom.slot)).sum() * geom.slot)
+
+
+def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
+                    num_rows: int, table_rows: int,
+                    candidates=None, force: bool = False):
+    """Pick the fastest-modeled binned geometry for this graph, or None if
+    the matmul backend's modeled cost beats every candidate (VERDICT r3
+    item 3: products-density graphs get a measured-stats policy instead of
+    the uniform-occupancy rejection).
+
+    Returns (geom, modeled_seconds), with geom None when matmul wins (and
+    the seconds then model matmul).  ``force=True`` always returns the best
+    binned candidate — the explicit `-aggr-backend binned` path, where
+    falling back to the dense default geometry on a sparse graph would
+    build a multi-GB plan."""
+    E = len(edge_src)
+    if E == 0:
+        return None, 0.0
+    cands = list(candidates) if candidates is not None else \
+        [_default_geom(), GEOM_MID, GEOM_SPARSE]
+    best, best_t = None, float("inf")
+    stats_cache = {}
+    for g in cands:
+        g = g.check()
+        sk = (g.sb, g.rb)
+        if sk not in stats_cache:
+            # occupancy histogram depends only on the window pair; slot
+            # variants reuse it
+            stats_cache[sk] = _cell_counts(edge_src, edge_dst, g.sb, g.rb)
+        cnt = stats_cache[sk]
+        padded = int((-(-cnt // g.slot)).sum() * g.slot)
+        t = _binned_cost_model(padded, g)
+        if t < best_t:
+            best, best_t = g, t
+    t_matmul = E * _MATMUL_NS_PER_EDGE * 1e-9
+    if force or best_t < t_matmul:
+        return best, best_t
+    return None, t_matmul
+
+
 def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
     """Exclusive prefix sum of `values` restarted at each change of `keys`
     (keys must be grouped).  Both [n]; returns [n]."""
@@ -160,8 +307,8 @@ def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
 
 def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
                       num_rows: int, table_rows: int,
-                      group_row_target: int = _GROUP_ROW_TARGET
-                      ) -> BinnedPlan:
+                      group_row_target: int = _GROUP_ROW_TARGET,
+                      geom: Geometry = None) -> BinnedPlan:
     """Host-side schedule: sort, slot-pad, and position every edge for both
     phases.  Big edge lists take the native C++ counting-sort builder
     (O(E), ~14x the NumPy lexsort path: 2.0 s vs 27.3 s at Reddit scale,
@@ -169,29 +316,34 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     NumPy fallback below is the correctness oracle
     (tests/test_binned.py::test_native_plan_equals_numpy)."""
     from roc_tpu import native
+    geom = (geom or _default_geom()).check()
     if len(edge_src) >= (1 << 20) and native.available():
         (p1_srcl, p1_off, p1_blk, p2_dstl, p2_obi, p2_first,
          bpg) = native.binned_plan(edge_src, edge_dst, num_rows, table_rows,
-                                   group_row_target)
+                                   group_row_target, geom)
         G, C1 = p1_blk.shape
         C2 = p2_obi.shape[1]
         return BinnedPlan(
-            p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * CH, 1)),
+            p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * geom.ch, 1)),
             p1_off=jnp.asarray(p1_off),
             p1_blk=jnp.asarray(p1_blk),
-            p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * CH2, 1)),
+            p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * geom.ch2, 1)),
             p2_obi=jnp.asarray(p2_obi),
             p2_first=jnp.asarray(p2_first),
-            num_rows=num_rows, table_rows=table_rows, bins_per_group=bpg)
+            num_rows=num_rows, table_rows=table_rows, bins_per_group=bpg,
+            geom=geom)
     return _build_binned_plan_numpy(edge_src, edge_dst, num_rows,
-                                    table_rows, group_row_target)
+                                    table_rows, group_row_target, geom)
 
 
 def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
                              num_rows: int, table_rows: int,
-                             group_row_target: int = _GROUP_ROW_TARGET
-                             ) -> BinnedPlan:
+                             group_row_target: int = _GROUP_ROW_TARGET,
+                             geom: Geometry = None) -> BinnedPlan:
     """The oracle plan builder (vectorized NumPy lexsort + prefix sums)."""
+    geom = (geom or _default_geom()).check()
+    SB, CH, SLOT, RB, CH2 = geom          # noqa: N806 — shadow the module
+    NSLOT, SLOT2 = geom.nslot, geom.slot2   # constants with plan geometry
     edge_src = np.asarray(edge_src, np.int64)
     edge_dst = np.asarray(edge_dst, np.int64)
     E = edge_src.shape[0]
@@ -312,7 +464,7 @@ def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
         p2_obi=jnp.asarray(p2_obi),
         p2_first=jnp.asarray(p2_first),
         num_rows=num_rows, table_rows=table_rows,
-        bins_per_group=bins_per_group)
+        bins_per_group=bins_per_group, geom=geom)
 
 
 # ---------------------------------------------------------------------------
@@ -349,12 +501,14 @@ def _stg_dtype(exact: bool):
 
 
 def _p1_kernel_simple(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf,
-                      offbuf, sems, *, exact: bool = False):
+                      offbuf, sems, *, exact: bool = False,
+                      geom: Geometry = None):
     """Single-buffered fallback (ROC_BINNED_NO_PIPELINE=1): issue all slot
     DMAs then drain them in the same chunk.  No cross-chunk overlap, but
     structurally identical to the skeleton measured on hardware — keep as
     the bisection baseline if the pipelined kernel misbehaves on a new
     Mosaic version."""
+    CH, SB, SLOT, NSLOT = geom.ch, geom.sb, geom.slot, geom.nslot  # noqa
     c = pl.program_id(0)
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
@@ -384,13 +538,14 @@ def _p1_kernel_simple(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf,
 
 
 def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
-               sems, *, exact: bool = False):
+               sems, *, exact: bool = False, geom: Geometry = None):
     """Double-buffered: the slot DMAs issued for chunk c drain at chunk
     c+2 (same gbuf parity), so the writes of one chunk overlap the next
     chunk's one-hot matmul.  ``offbuf`` keeps each parity's issued offsets
     (the wait must reconstruct the same descriptors); pad slots carry
     offset -1 and are skipped — per-block chunk rounding makes them
     20-40% of all slots, so not writing them matters."""
+    CH, SB, SLOT, NSLOT = geom.ch, geom.sb, geom.slot, geom.nslot  # noqa
     c = pl.program_id(0)
     par = c % 2
 
@@ -438,14 +593,16 @@ def _p1_kernel(blk_ref, off_ref, srcl_ref, x_ref, stg_ref, gbuf, offbuf,
 
 
 @partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret",
-                                   "exact"))
+                                   "exact", "geom"))
 def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
-            interpret: bool = False, exact: bool = False):
+            interpret: bool = False, exact: bool = False,
+            geom: Geometry = None):
     kernel = _p1_kernel_simple \
         if os.environ.get("ROC_BINNED_NO_PIPELINE") else _p1_kernel
-    kernel = partial(kernel, exact=exact)
+    kernel = partial(kernel, exact=exact, geom=geom)
     H = x.shape[-1]
     st = _stg_dtype(exact)
+    CH, SB, NSLOT = geom.ch, geom.sb, geom.nslot                   # noqa
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,                  # blk [C1]
         grid=(nchunks,),
@@ -472,7 +629,8 @@ def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
 # ---------------------------------------------------------------------------
 
 def _p2_kernel(obi_ref, first_ref, dstl_ref, stg_ref, out_ref, *,
-               exact: bool = False):
+               exact: bool = False, geom: Geometry = None):
+    CH2, RB = geom.ch2, geom.rb                                    # noqa
     c = pl.program_id(0)
 
     @pl.when(first_ref[c] == 1)
@@ -489,10 +647,12 @@ def _p2_kernel(obi_ref, first_ref, dstl_ref, stg_ref, out_ref, *,
 
 
 @partial(jax.jit, static_argnames=("nchunks", "out_rows", "interpret",
-                                   "exact"))
+                                   "exact", "geom"))
 def _p2_run(stg, obi, first, dstl, nchunks: int, out_rows: int,
-            interpret: bool = False, exact: bool = False):
+            interpret: bool = False, exact: bool = False,
+            geom: Geometry = None):
     H = stg.shape[-1]
+    CH2, RB = geom.ch2, geom.rb                                    # noqa
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                  # obi, first
         grid=(nchunks,),
@@ -503,7 +663,7 @@ def _p2_run(stg, obi, first, dstl, nchunks: int, out_rows: int,
         out_specs=pl.BlockSpec((RB, H), lambda c, obi, first: (obi[c], 0)),
     )
     return pl.pallas_call(
-        partial(_p2_kernel, exact=exact), grid_spec=grid_spec,
+        partial(_p2_kernel, exact=exact, geom=geom), grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((out_rows, H), jnp.float32),
         interpret=interpret,
     )(obi, first, dstl, stg)
@@ -540,24 +700,27 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False,
     # the extra lanes ride the same tiles the hardware moves anyway.
     H = x.shape[-1]
     Hp = _pad_to(H, 128)
+    geom = plan.geom or _default_geom()
     G, C1 = plan.p1_blk.shape
     C2 = plan.p2_obi.shape[1]
-    xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, SB) - x.shape[0]),
+    xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, geom.sb) - x.shape[0]),
                      (0, Hp - H)))
-    stg_rows = C2 * CH2
+    stg_rows = C2 * geom.ch2
 
     def body(_, gplan):
         srcl, off, blk, dstl, obi, first = gplan
-        stg = _p1_run(xp, blk, off, srcl, C1, stg_rows, interpret, exact)
+        stg = _p1_run(xp, blk, off, srcl, C1, stg_rows, interpret, exact,
+                      geom)
         out_g = _p2_run(stg, obi, first, dstl, C2,
-                        plan.bins_per_group * RB, interpret, exact)
+                        plan.bins_per_group * geom.rb, interpret, exact,
+                        geom)
         return None, out_g
 
     _, outs = jax.lax.scan(
         body, None,
         (plan.p1_srcl, plan.p1_off, plan.p1_blk,
          plan.p2_dstl, plan.p2_obi, plan.p2_first))
-    out = outs.reshape(G * plan.bins_per_group * RB, Hp)
+    out = outs.reshape(G * plan.bins_per_group * geom.rb, Hp)
     return out[:plan.num_rows, :H].astype(x.dtype)
 
 
@@ -568,6 +731,7 @@ def pad_binned_plan(plan: BinnedPlan, C1: int, C2: int) -> BinnedPlan:
 
     Pad phase-1 chunks: block 0, all slots skipped (-1).  Pad phase-2
     chunks: revisit the last bin with first=0 and every row masked (RB)."""
+    geom = plan.geom or _default_geom()
     G, c1 = plan.p1_blk.shape
     c2 = plan.p2_obi.shape[1]
     assert C1 >= c1 and C2 >= c2 and C1 % 8 == 0
@@ -575,13 +739,13 @@ def pad_binned_plan(plan: BinnedPlan, C1: int, C2: int) -> BinnedPlan:
     if d1 == 0 and d2 == 0:
         return plan
     return BinnedPlan(
-        p1_srcl=jnp.pad(plan.p1_srcl, ((0, 0), (0, d1 * CH), (0, 0))),
+        p1_srcl=jnp.pad(plan.p1_srcl, ((0, 0), (0, d1 * geom.ch), (0, 0))),
         p1_off=jnp.pad(plan.p1_off, ((0, 0), (0, d1), (0, 0)),
                        constant_values=-1),
         p1_blk=jnp.pad(plan.p1_blk, ((0, 0), (0, d1))),
-        p2_dstl=jnp.pad(plan.p2_dstl, ((0, 0), (0, d2 * CH2), (0, 0)),
-                        constant_values=RB),
+        p2_dstl=jnp.pad(plan.p2_dstl, ((0, 0), (0, d2 * geom.ch2), (0, 0)),
+                        constant_values=geom.rb),
         p2_obi=jnp.pad(plan.p2_obi, ((0, 0), (0, d2)), mode="edge"),
         p2_first=jnp.pad(plan.p2_first, ((0, 0), (0, d2))),
         num_rows=plan.num_rows, table_rows=plan.table_rows,
-        bins_per_group=plan.bins_per_group)
+        bins_per_group=plan.bins_per_group, geom=plan.geom)

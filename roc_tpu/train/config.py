@@ -74,6 +74,10 @@ class Config:
                                   # padded-max tax exceeds ~30% (docs/PERF.md
                                   # rule of thumb); True/"on", False/"off"
                                   # force it
+    reorder: bool = False         # RCM locality pass before partitioning
+                                  # (graph/reorder.py — concentrates the
+                                  # (block, bin) cells the TPU tiled kernels
+                                  # pay for; no reference counterpart)
 
     def exchange_mode(self) -> str:
         """Effective exchange mode ('halo' | 'allgather' | 'ring')."""
@@ -122,6 +126,7 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-perhost", dest="perhost_load", action="store_true")
     p.add_argument("-edge-shard", dest="edge_shard", nargs="?", const="on",
                    default="auto", choices=["on", "off", "auto"])
+    p.add_argument("-reorder", action="store_true")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

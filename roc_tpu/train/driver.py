@@ -76,23 +76,44 @@ AUTO_MATMUL_EDGES = 1 << 20
 AUTO_BINNED = True
 
 
-def resolve_backend(backend: str, num_edges: int, num_rows: int = 0,
-                    table_rows: int = 0) -> str:
+def resolve_backend_geom(backend: str, num_edges: int, num_rows: int = 0,
+                         table_rows: int = 0, edge_src=None, edge_dst=None):
+    """Resolve the aggregation backend; returns (backend, geometry).
+
+    With edge arrays provided, the binned-vs-matmul call uses ACTUAL cell
+    statistics (choose_geometry's calibrated cost model, incl. the
+    sparse-graph geometry presets) instead of the uniform-occupancy bound —
+    a locality-preserving vertex order is credited for the cells it never
+    touches, which is what gives products-density graphs a binned path.
+    The chosen forward-direction Geometry rides back so the plan build
+    doesn't redo the O(E) statistics (None when no choice was made)."""
     if backend == "auto":
         on_tpu = jax.default_backend() == "tpu"
         if not (on_tpu and num_edges >= AUTO_MATMUL_EDGES):
-            return "xla"
-        from roc_tpu.ops.pallas.binned import binned_viable
-        if AUTO_BINNED and num_rows and binned_viable(num_rows, table_rows,
-                                                      num_edges):
-            return "binned"
-        return "matmul"
+            return "xla", None
+        from roc_tpu.ops.pallas.binned import binned_viable, choose_geometry
+        if AUTO_BINNED and num_rows:
+            if edge_src is not None:
+                g, _ = choose_geometry(edge_src, edge_dst, num_rows,
+                                       table_rows)
+                if g is not None:
+                    return "binned", g
+            elif binned_viable(num_rows, table_rows, num_edges):
+                return "binned", None
+        return "matmul", None
     if backend == "pallas":
         # Round-1's blocked-CSR kernel cannot lower on hardware (per-row DMA
         # slices of tiled HBM refs; docs/PERF.md); "pallas" now names the
         # binned two-phase kernel pair (ops/pallas/binned.py).
-        return "binned"
-    return backend
+        return "binned", None
+    return backend, None
+
+
+def resolve_backend(backend: str, num_edges: int, num_rows: int = 0,
+                    table_rows: int = 0, edge_src=None,
+                    edge_dst=None) -> str:
+    return resolve_backend_geom(backend, num_edges, num_rows, table_rows,
+                                edge_src, edge_dst)[0]
 
 
 def resolve_gat_backend(backend: str, num_edges: int) -> str:
@@ -109,15 +130,19 @@ def resolve_gat_backend(backend: str, num_edges: int) -> str:
 def dense_graph_data(graph, backend: str = "xla",
                      precision: str = "exact",
                      gat_backend: str = "xla") -> DenseGraphData:
-    backend = resolve_backend(backend, graph.num_edges, graph.num_nodes,
-                              graph.num_nodes)
+    backend, geom = resolve_backend_geom(
+        backend, graph.num_edges, graph.num_nodes, graph.num_nodes,
+        graph.col_idx, graph.dst_idx)
     plans = None
     if backend == "matmul":
         plans = ops.build_aggregate_plans(
             graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
     elif backend == "binned":
+        # fwd rides the geometry the resolution already chose (if any);
+        # bwd (the transposed direction) still chooses its own
         plans = ops.build_binned_plans(
-            graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
+            graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes,
+            geom=(geom or "auto", "auto"))
     gat_plans = None
     if gat_backend == "plan":
         from roc_tpu.ops.edge import build_gat_plans

@@ -45,6 +45,19 @@ timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-64-41 \
     -e 10 -parts 4 -model gat -heads 2 -aggr-backend matmul -v 2>&1 \
     | tail -2 | tee -a "$LOG"
 
+note "2d. products-shape single-chip A/B (the north-star graph, VERDICT r4:"
+note "    measure matmul vs binned-auto-geometry; record winner in BASELINE)"
+PROD="env ROC_BENCH_SHAPE=products ROC_BENCH_NODES=2449029 ROC_BENCH_DEG=51"
+PROD="$PROD ROC_BENCH_LAYERS=100-256-47 ROC_BENCH_EPOCHS=5"
+for be in matmul auto; do
+    $PROD ROC_BENCH_BACKEND=$be timeout 3000 python bench.py 2>&1 \
+        | tail -2 | tee -a "$LOG"
+done
+# with the RCM locality pass: choose_geometry should then pick a binned
+# geometry (graph/reorder.py) — the candidate winner for the north star
+$PROD ROC_BENCH_BACKEND=auto ROC_BENCH_REORDER=1 timeout 3000 \
+    python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+
 note "3. group-count sweep (fewer groups -> less phase-1 rounding)"
 for grt in 2097152 4194304 8388608; do
     note "   ROC_BINNED_GROUP_ROWS=$grt"
