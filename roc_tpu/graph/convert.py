@@ -296,6 +296,59 @@ def karate_club(*, train_nodes=(0, 33)) -> Dataset:
                    undirected=True, self_edges=True)
 
 
+def davis_women(*, train_nodes=(0, 13)) -> Dataset:
+    """Davis-Gardner-Gardner Southern Women (1941) — a *real* bipartite
+    attendance network (18 women x 14 social events, 89 attendances,
+    observed in Natchez, Mississippi in the 1930s; published in *Deep
+    South*, 1941).  Vendored under data/davis/ (public-domain figures via
+    networkx).  Labels on the women are Freeman's consensus two-group
+    split (*Finding Social Groups: A Meta-Analysis of the Southern Women
+    Data*, 2003 — the agreement of 21 independent published analyses);
+    event nodes are unlabeled (mask NONE).
+
+    The oracle task mirrors the karate recipe on a BIPARTITE graph: train
+    on one seed woman per group (node 0 = Evelyn Jefferson, node 13 =
+    Nora Fayette), predict the remaining 16 women's group through the
+    event nodes — two GCN hops = co-attendance.  Deterministic curve
+    pinned in docs/GOLDEN.md."""
+    d = os.path.join(_VENDOR_DIR, "davis")
+    src, dst = read_edge_file(os.path.join(d, "davis.edges"))
+    labels = np.loadtxt(os.path.join(d, "davis.labels"),
+                        dtype=np.int64).reshape(-1)
+    n = labels.shape[0]
+    mask = np.full(n, lux.MASK_NONE, dtype=np.int32)
+    mask[labels >= 0] = lux.MASK_TEST          # women; events stay NONE
+    mask[list(train_nodes)] = lux.MASK_TRAIN
+    labels = np.maximum(labels, 0)     # events: dummy class, masked NONE
+    return _finish("davis", n, src, dst, None, labels, mask,
+                   undirected=True, self_edges=True)
+
+
+def les_miserables(*, per_class_train=2, seed: int = 0) -> Dataset:
+    """Knuth's Les Misérables co-occurrence network (1993) — a *real*
+    literary graph (77 characters, 254 co-occurrence edges; the standard
+    community-detection benchmark of Newman 2004).  Vendored under
+    data/lesmis/ (public-domain figures via networkx).
+
+    Labels are the 5 Clauset-Newman-Moore greedy-modularity communities
+    (Q = 0.4729), computed deterministically at vendor time and checked in
+    — NOT hand-assigned (data/lesmis/README.md documents the provenance).
+    With identity features, ``per_class_train`` seeds per community, and
+    the rest split val/test, a 2-layer GCN lands well below 100%: the
+    repo's one real NON-SATURATING accuracy oracle (docs/GOLDEN.md), where
+    a plan/kernel bug costing 1-2% accuracy actually moves the pin."""
+    d = os.path.join(_VENDOR_DIR, "lesmis")
+    src, dst = read_edge_file(os.path.join(d, "lesmis.edges"))
+    labels = np.loadtxt(os.path.join(d, "lesmis.labels"),
+                        dtype=np.int64).reshape(-1)
+    n = labels.shape[0]
+    ncls = int(labels.max()) + 1
+    mask = stratified_split(labels, per_class_train * ncls, n // 4,
+                            n - per_class_train * ncls - n // 4, seed=seed)
+    return _finish("lesmis", n, src, dst, None, labels, mask,
+                   undirected=True, self_edges=True)
+
+
 def write(ds: Dataset, prefix: str) -> None:
     """Write a converted dataset to disk in the reference's on-disk layout
     (``<prefix>.add_self_edge.lux`` + sidecars)."""

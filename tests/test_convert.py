@@ -144,6 +144,74 @@ def test_karate_is_the_real_graph():
     assert int((ds.mask == lux.MASK_TEST).sum()) == 32
 
 
+def test_davis_is_the_real_graph():
+    ds = convert.davis_women()
+    assert ds.graph.num_nodes == 32            # 18 women + 14 events
+    assert ds.graph.num_edges == 2 * 89 + 32   # symmetrized + self-edges
+    # Freeman's consensus split is 9 women per group; events unlabeled
+    assert int((ds.label_ids[:18] == 0).sum()) == 9
+    assert int((ds.label_ids[:18] == 1).sum()) == 9
+    assert list(np.nonzero(ds.mask == lux.MASK_TRAIN)[0]) == [0, 13]
+    assert int((ds.mask == lux.MASK_TEST).sum()) == 16
+    assert int((ds.mask[18:] == lux.MASK_NONE).sum()) == 14
+
+
+def test_lesmis_is_the_real_graph():
+    ds = convert.les_miserables()
+    assert ds.graph.num_nodes == 77
+    assert ds.graph.num_edges == 2 * 254 + 77
+    assert ds.num_classes == 5                 # CNM modularity communities
+    assert int((ds.mask == lux.MASK_TRAIN).sum()) == 10   # 2 per class
+
+
+@pytest.mark.slow
+def test_golden_davis_curve():
+    """Real-data golden curve on a BIPARTITE graph (docs/GOLDEN.md):
+    2-layer GCN, identity features, train = one seed woman per group
+    (Evelyn, Nora).  Must reproduce Freeman's consensus split for 15 of
+    the 16 held-out women, with node 15 (Dorothy Murchison — one of the
+    classically ambiguous cases; she attended only two events) the sole
+    miss."""
+    import jax
+
+    ds = convert.davis_women()
+    cfg = Config(layers=[32, 16, 2], num_epochs=100, learning_rate=0.01,
+                 weight_decay=5e-4, dropout_rate=0.5, eval_every=10**9)
+    tr = Trainer(cfg, ds, build_model("gcn", cfg.layers, cfg.dropout_rate,
+                                      "sum"))
+    for _ in range(100):
+        tr.run_epoch()
+    m = jax.device_get(tr.evaluate())
+    assert int(m.test_correct) == 15 and int(m.test_all) == 16
+    pred = np.argmax(np.asarray(tr.predict_logits()), axis=-1)
+    women = np.arange(18)
+    assert list(women[(pred[:18] != ds.label_ids[:18])]) == [15]
+
+
+@pytest.mark.slow
+def test_golden_lesmis_curve():
+    """The repo's one real NON-SATURATING pin (docs/GOLDEN.md): 5-class
+    community recovery on Knuth's Les Misérables graph lands near 90%,
+    not 100% — so a kernel/plan bug costing 1-2 samples moves this
+    assert.  Measured (CPU, seed 1): epoch 50 val 15/19 test 45/48;
+    epoch 200 val 15/19 test 45/48, train loss 0.34.  Pins leave
+    2-sample cross-platform headroom."""
+    import jax
+
+    ds = convert.les_miserables()
+    cfg = Config(layers=[77, 16, 5], num_epochs=200, learning_rate=0.01,
+                 weight_decay=5e-4, dropout_rate=0.5, seed=1,
+                 eval_every=10**9)
+    tr = Trainer(cfg, ds, build_model("gcn", cfg.layers, cfg.dropout_rate,
+                                      "sum"))
+    for _ in range(200):
+        tr.run_epoch()
+    m = jax.device_get(tr.evaluate())
+    assert int(m.val_correct) >= 13 and int(m.val_all) == 19
+    assert int(m.test_correct) >= 43 and int(m.test_all) == 48
+    assert float(m.train_loss) <= 1.0
+
+
 @pytest.mark.slow
 def test_golden_karate_curve():
     """Real-data golden curve (docs/GOLDEN.md): 2-layer GCN, identity

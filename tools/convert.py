@@ -66,6 +66,10 @@ def main(argv=None) -> int:
 
     sub.add_parser("karate",
                    help="vendored real graph: Zachary's karate club")
+    sub.add_parser("davis", help="vendored real graph: Davis Southern "
+                                 "Women (1941, bipartite)")
+    sub.add_parser("lesmis", help="vendored real graph: Les Misérables "
+                                  "co-occurrences (Knuth 1993)")
 
     for s in sub.choices.values():
         s.add_argument("-o", "--out", required=True,
@@ -91,6 +95,10 @@ def main(argv=None) -> int:
                               feats_path=a.feats, undirected=a.undirected,
                               self_edges=not a.no_self_edges, split=split,
                               seed=a.seed)
+    elif a.cmd == "davis":
+        ds = convert.davis_women()
+    elif a.cmd == "lesmis":
+        ds = convert.les_miserables()
     else:
         ds = convert.karate_club()
     convert.write(ds, a.out)
