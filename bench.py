@@ -85,19 +85,26 @@ PRECISION = os.environ.get("ROC_BENCH_PRECISION", "fast")
 # ROC_BENCH_REORDER=1: RCM locality pass before training (graph/reorder.py)
 # — annotates the metric; the canonical number stays unreordered.
 REORDER = _env("ROC_BENCH_REORDER", "0", int) != 0
+# ROC_BENCH_INTER=ring: inter-community edges go to ring-adjacent
+# communities (hierarchical locality, the structure real co-purchase
+# graphs have) instead of uniformly — the case a locality reorder can
+# exploit.  Annotates the metric; canonical stays uniform.
+INTER = os.environ.get("ROC_BENCH_INTER", "uniform")
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
 CANONICAL_SHAPE = (SHAPE == "reddit"
                    and "ROC_BENCH_NODES" not in os.environ
                    and "ROC_BENCH_DEG" not in os.environ
-                   and LAYERS == [602, 256, 41])
+                   and LAYERS == [602, 256, 41]
+                   and INTER == "uniform")
 METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + (f"_heads{HEADS}" if MODEL == "gat" else "")
           + "_epoch_time"
           + ("" if SCALE == 1.0 else f"_scale{SCALE:g}")
           + ("" if PRECISION == "fast" else f"_{PRECISION}")
-          + ("_reorder" if REORDER else ""))
+          + ("_reorder" if REORDER else "")
+          + ("" if INTER == "uniform" else f"_inter-{INTER}"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -263,7 +270,7 @@ def _cached_dataset():
                       n_test=int(NODES * 0.2))
     args = dict(gen="synthetic-v1", p_intra=0.8, feature_snr=1.0,
                 num_nodes=NODES, avg_degree=AVG_DEG, in_dim=IN_DIM,
-                num_classes=CLASSES, seed=1, **splits)
+                num_classes=CLASSES, seed=1, inter=INTER, **splits)
     key = "_".join(f"{k}={v}" for k, v in sorted(args.items()))
     digest = hashlib.sha1(key.encode()).hexdigest()[:12]
     path = f"/tmp/roc_bench_{digest}.npz"
@@ -282,7 +289,7 @@ def _cached_dataset():
         pass
     ds = datasets.synthetic(f"{SHAPE}-bench", NODES, AVG_DEG, IN_DIM, CLASSES,
                             n_train=args["n_train"], n_val=args["n_val"],
-                            n_test=args["n_test"], seed=1)
+                            n_test=args["n_test"], seed=1, inter_mode=INTER)
     try:
         tmp = f"{path}.{os.getpid()}.tmp"   # private tmp: concurrent runs
         with open(tmp, "wb") as f:       # exact name; savez won't rename

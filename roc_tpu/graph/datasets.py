@@ -80,13 +80,22 @@ def load_roc_dataset(prefix: str, in_dim: int, num_classes: int,
 def synthetic(name: str, num_nodes: int, avg_degree: float, in_dim: int,
               num_classes: int, *, n_train: int, n_val: int, n_test: int,
               p_intra: float = 0.8, feature_snr: float = 1.0,
-              seed: int = 0) -> Dataset:
+              seed: int = 0, inter_mode: str = "uniform") -> Dataset:
     """Deterministic SBM-style graph with class-informative features.
 
     Edges prefer endpoints in the same class block with probability
     ``p_intra``; features are a per-class mean plus unit Gaussian noise.  A
     2-layer GCN reaches high val/test accuracy on these, giving us the same
     kind of end-to-end oracle the reference relies on.
+
+    ``inter_mode`` shapes the (1 - p_intra) inter-community edges:
+    "uniform" (default, the historical behavior) spreads them over the
+    whole graph — the locality WORST case, since even an optimal vertex
+    order leaves those edges touching ~every (block, bin) tile;
+    "ring" sends them to the two adjacent communities (communities on a
+    ring) — the hierarchical-locality structure real co-purchase/social
+    graphs exhibit, which a reordering pass (graph/reorder.py) can
+    actually exploit.  Benchmarks label which one they measured.
     """
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, num_classes, size=num_nodes)
@@ -103,6 +112,18 @@ def synthetic(name: str, num_nodes: int, avg_degree: float, in_dim: int,
     cls = labels[src[intra]]
     pos = class_start[cls] + (rng.random(intra.sum()) * class_count[cls]).astype(np.int64)
     dst[intra] = order[np.minimum(pos, num_nodes - 1)]
+    if inter_mode == "ring":
+        # inter edges land in a neighbor community on the class ring
+        inter = ~intra
+        cls_i = labels[src[inter]]
+        step = np.where(rng.random(inter.sum()) < 0.5, 1,
+                        num_classes - 1).astype(np.int64)
+        tgt = (cls_i + step) % num_classes
+        pos_i = class_start[tgt] + (rng.random(inter.sum())
+                                    * class_count[tgt]).astype(np.int64)
+        dst[inter] = order[np.minimum(pos_i, num_nodes - 1)]
+    elif inter_mode != "uniform":
+        raise ValueError(f"inter_mode={inter_mode!r}: uniform|ring")
     # symmetrize (undirected, like the citation benchmarks)
     s = np.concatenate([src, dst])
     d = np.concatenate([dst, src])
@@ -139,12 +160,27 @@ _REGISTRY = {
 }
 
 
+# Vendored REAL graphs (data/*/README.md), fetched by the same `-dataset`
+# name as the synthetic stand-ins: name -> constructor attr on
+# roc_tpu.graph.convert (one mapping; names() derives from it).  `seed`
+# does not apply: karate/davis use the canonical published splits, and
+# lesmis pins its golden-curve split (convert.les_miserables's default
+# seed) — the docs/GOLDEN.md pins are fixed-split by design.
+_REAL = {"karate": "karate_club", "davis": "davis_women",
+         "lesmis": "les_miserables"}
+
+
 def get(name: str, seed: int = 0) -> Dataset:
-    """Fetch a named synthetic dataset (deterministic for a given seed)."""
+    """Fetch a named dataset: a vendored real graph (fixed canonical
+    split; `seed` ignored), or a deterministic synthetic stand-in
+    (seeded)."""
+    if name in _REAL:
+        from roc_tpu.graph import convert
+        return getattr(convert, _REAL[name])()
     n, deg, in_dim, classes, ntr, nva, nte = _REGISTRY[name]
     return synthetic(name, n, deg, in_dim, classes,
                      n_train=ntr, n_val=nva, n_test=nte, seed=seed)
 
 
 def names():
-    return sorted(_REGISTRY)
+    return sorted(_REGISTRY) + list(_REAL)

@@ -43,18 +43,29 @@ def _union_neighbors(g: Csr, gt: Csr, frontier: np.ndarray) -> np.ndarray:
     return np.concatenate(outs)
 
 
-def rcm_order(g: Csr) -> np.ndarray:
+def rcm_order(g: Csr, use_native: bool = None) -> np.ndarray:
     """Reverse-Cuthill-McKee-style order: ``order[new_id] = old_id``.
 
     BFS treats the graph as undirected (in- plus out-neighbors); levels are
     visited in increasing total-degree order (ids break ties).  Isolated
     vertices (self-loop only) go to the end in id order — they touch no
     off-diagonal cells, so their position is irrelevant to locality.
+
+    Big graphs take the C++ BFS (roc_native.cc roc_rcm_order — the (deg,
+    id) level order is a unique total order, so it matches this NumPy
+    oracle element for element; pinned in tests/test_reorder.py); the
+    vectorized level-synchronous NumPy path below is the oracle.
     """
     n = g.num_nodes
     if n == 0:
         return np.zeros(0, np.int64)
     gt = g.transpose()
+    from roc_tpu import native
+    if use_native is None:
+        use_native = g.num_edges >= (1 << 20)
+    if use_native and native.available():
+        return native.rcm_order(g.row_ptr, g.col_idx, gt.row_ptr,
+                                gt.col_idx)
     deg_in = np.diff(g.row_ptr)
     deg_out = np.diff(gt.row_ptr)
     # self-loops count toward both; subtract them from the "connects me to

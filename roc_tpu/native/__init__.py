@@ -104,6 +104,9 @@ def _bind(L: ctypes.CDLL) -> None:
     L.roc_binned_plan_fill_g.argtypes = [i64p, i64p, i64p] + \
         [ctypes.c_int64] * 7 + [i32p] * 6
     L.roc_binned_plan_fill_g.restype = ctypes.c_int
+    L.roc_rcm_order.argtypes = [i64p, i32p, i64p, i32p, ctypes.c_int64,
+                                i64p]
+    L.roc_rcm_order.restype = ctypes.c_int
 
 
 def available() -> bool:
@@ -292,3 +295,22 @@ def binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int,
     return (p1_srcl.reshape(G, C1 * CH), p1_off.reshape(G, C1, NSLOT),
             p1_blk.reshape(G, C1), p2_dstl.reshape(G, C2 * CH2),
             p2_obi.reshape(G, C2), p2_first.reshape(G, C2), bpg)
+
+
+def rcm_order(row_ptr: np.ndarray, col_idx: np.ndarray,
+              t_row_ptr: np.ndarray, t_col_idx: np.ndarray) -> np.ndarray:
+    """RCM locality order (see graph/reorder.py) — the O(E) C++ BFS.
+    Takes the in-edge CSR and its transpose; returns order[new] = old,
+    element-identical to the NumPy oracle."""
+    L = lib()
+    assert L is not None
+    N = len(row_ptr) - 1
+    out = np.empty(N, np.int64)
+    rc = L.roc_rcm_order(np.ascontiguousarray(row_ptr, np.int64),
+                         np.ascontiguousarray(col_idx, np.int32),
+                         np.ascontiguousarray(t_row_ptr, np.int64),
+                         np.ascontiguousarray(t_col_idx, np.int32),
+                         N, out)
+    if rc != 0:
+        raise RuntimeError(f"roc_rcm_order rc={rc}")
+    return out

@@ -1209,16 +1209,22 @@ class SpmdTrainer(BaseTrainer):
             # choice; auto edge-shard must not silently override it
             return False
         # "auto": a perf heuristic — only skewed partitions benefit (the
-        # padded-max tax IS the skew cost).  GAT is excluded from AUTO
-        # only: _edge_attend is the correctness path (its backward
-        # scatters serialize on TPU); an explicit -edge-shard on is
-        # honored for attention models.
+        # padded-max tax IS the skew cost).
         if self.k > 1:        # overcommit is vertex-mode only
             return False
         aggrs = self._model_aggrs()
-        if any(op.kind == "gat" for op in self.model.ops):
+        has_gat = any(op.kind == "gat" for op in self.model.ops)
+        if has_gat and self._gat_backend() != "plan":
+            # On the xla attention backend, _edge_attend is the
+            # correctness path (its autodiff backward scatters serialize
+            # on TPU) — not an auto perf win.  Since round 4 the PLAN
+            # backend (edge_gat_attend) is scatter-free fwd+bwd, so GAT
+            # auto-enables exactly when plan attention would serve it;
+            # explicit -edge-shard on is honored either way.
             return False
-        if not aggrs or aggrs - {"sum", "avg"}:
+        if aggrs - {"sum", "avg"}:
+            return False
+        if not aggrs and not has_gat:
             return False
         tax = _padded_max_tax(self.part)
         if tax > self.EDGE_SHARD_TAX:

@@ -127,10 +127,18 @@ def test_edge_shard_auto_selection():
                      build_gcn(base["layers"], 0.0))
     assert not t3._use_edge_shard
 
-    # GAT models must never auto-enable (attention needs the source table)
+    # GAT on the XLA attention backend must not auto-enable (_edge_attend's
+    # autodiff backward scatters serialize on TPU — correctness path only)
     t4 = SpmdTrainer(Config(**base, model="gat"), hub_ds,
                      build_gat(base["layers"], 0.0))
     assert not t4._use_edge_shard
+    # ...but on the PLAN backend (scatter-free edge_gat_attend, round 4)
+    # the same hub graph auto-enables
+    t5 = SpmdTrainer(Config(**base, model="gat",
+                            aggregate_backend="matmul"), hub_ds,
+                     build_gat(base["layers"], 0.0))
+    assert t5._use_edge_shard and t5.gdata.mode == "edge"
+    assert t5.gdata.gat_plans is not None
 
 
 @pytest.mark.parametrize("model_builder,kwargs",

@@ -79,6 +79,29 @@ def test_rcm_restores_cell_locality():
         (geom_after, t_after, t_before)
 
 
+def test_native_rcm_equals_numpy():
+    """The C++ BFS must reproduce the NumPy oracle element for element
+    (the (deg, id) level order is a unique total order)."""
+    from roc_tpu import native
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(4)
+    for (n, q, e) in [(2048, 128, 12_000), (4096, 256, 9_000),
+                      (300, 50, 400)]:
+        g = _community_graph(n, q, e, rng)
+        np.testing.assert_array_equal(
+            rcm_order(g, use_native=True), rcm_order(g, use_native=False),
+            err_msg=f"n={n} q={q} e={e}")
+    # graph with isolated vertices (self-loop only)
+    from roc_tpu.graph.csr import add_self_edges, from_edges
+    src = np.array([0, 1, 2], np.int64)
+    dst = np.array([1, 2, 0], np.int64)
+    g = add_self_edges(from_edges(10, src, dst))
+    np.testing.assert_array_equal(rcm_order(g, use_native=True),
+                                  rcm_order(g, use_native=False))
+
+
 def test_reorder_dataset_trains_isomorphically():
     """Same losses (up to fp32 reassociation) with and without the reorder:
     features/labels/masks move with their vertices."""

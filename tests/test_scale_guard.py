@@ -191,6 +191,62 @@ def test_products_shape_perhost_end_to_end(tmp_path):
           f"peak {_peak_rss_gb():.1f} GB")
 
 
+@pytest.mark.slow
+def test_papers100m_sixteenth_rehearsal(tmp_path):
+    """The papers100M configuration at 1/16 linear scale, end to end
+    (VERDICT r3 item 7): 6.94M nodes / 2.09e8 edges written in the
+    on-disk format, loaded perhost (graph stub + byte-range reads), and an
+    8-LAYER GCN (the BASELINE.json depth, deep-residual path incl.) with
+    -bf16 trained one epoch on the 8-virtual-device mesh.  Budgets are
+    generous absolutes a superlinear builder or program-build regression
+    cannot meet — this is ~1.7x the products guard's edge count AND 4x its
+    layer count, so it exercises the deep-program compile path the other
+    guards don't."""
+    from roc_tpu.graph import datasets, lux
+    from roc_tpu.models import build_gcn
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    from roc_tpu.train.config import Config
+
+    N, E, P = 111_059_956 // 16, 3_340_000_000 // 16, 8
+    in_dim, hidden, classes = 8, 8, 8   # width scaled: graph-scale path +
+    layers = [in_dim] + [hidden] * 7 + [classes]   # depth, not the GEMMs
+    g = _uniform_graph(N, E, seed=2)
+    prefix = str(tmp_path / "papers16")
+    t0 = time.monotonic()
+    lux.write_lux(prefix + lux.LUX_SUFFIX, g)
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((N, in_dim)).astype(np.float32)
+    feats.tofile(prefix + ".feats.bin")
+    rng.integers(0, classes, N).astype(np.int32).tofile(
+        prefix + ".label.bin")
+    mask = np.full(N, lux.MASK_NONE, np.int32)
+    mask[:100_000] = lux.MASK_TRAIN
+    with open(prefix + ".mask", "w") as f:
+        f.write("\n".join("Train" if m == lux.MASK_TRAIN else "None"
+                          for m in mask) + "\n")
+    t_write = time.monotonic() - t0
+
+    ds = datasets.load_roc_dataset(prefix, in_dim, classes, graph_stub=True)
+    cfg = Config(layers=layers, num_epochs=1, dropout_rate=0.0,
+                 num_parts=P, halo=True, perhost_load=True, filename=prefix,
+                 eval_every=10**9, aggregate_backend="xla", lazy_load=True,
+                 use_bf16=True)
+    t0 = time.monotonic()
+    tr = SpmdTrainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    t_setup = time.monotonic() - t0
+    t0 = time.monotonic()
+    loss = float(tr.run_epoch())
+    t_epoch = time.monotonic() - t0
+    assert np.isfinite(loss)
+    peak = _peak_rss_gb()
+    # superlinearity guard: generous absolutes (CPU, 8 virtual devices)
+    assert t_setup < 900, f"perhost setup took {t_setup:.0f}s"
+    assert t_epoch < 1500, f"8-layer epoch took {t_epoch:.0f}s"
+    assert peak < 80, f"peak RSS {peak:.1f} GB"
+    print(f"# papers16 rehearsal: write {t_write:.0f}s setup {t_setup:.0f}s "
+          f"epoch {t_epoch:.0f}s loss {loss:.2f} peak {peak:.1f} GB")
+
+
 def test_papers100m_fits_v5p_hbm():
     """BASELINE.md target config: 8-layer GCN on ogbn-papers100M across a
     v5p-32 slice.  Pure geometry computation (no arrays): the per-device

@@ -57,6 +57,13 @@ done
 # geometry (graph/reorder.py) — the candidate winner for the north star
 $PROD ROC_BENCH_BACKEND=auto ROC_BENCH_REORDER=1 timeout 3000 \
     python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+# hierarchical-locality variant (inter edges ring-adjacent, the structure
+# real co-purchase graphs have): A/B the reorder win where it can exist —
+# the uniform-inter runs above are the locality worst case
+for rr in 0 1; do
+    $PROD ROC_BENCH_BACKEND=auto ROC_BENCH_INTER=ring ROC_BENCH_REORDER=$rr \
+        timeout 3000 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+done
 
 note "3. group-count sweep (fewer groups -> less phase-1 rounding)"
 for grt in 2097152 4194304 8388608; do
