@@ -41,6 +41,19 @@ from roc_tpu.graph.partition import PartitionMeta, compute_meta
 AllGather = Callable[[np.ndarray], np.ndarray]
 
 
+def allgather_floors(counts, allgather) -> "list[int]":
+    """Cross-process static-shape floors: local per-side maxima →
+    allgather → global maxima.  Every process must compile the SAME
+    shard_map program, so per-shard pad targets take the global max chunk
+    count per side.  ``counts``: [n_sides][n_local_shards] ints;
+    ``allgather`` None (single-process) returns the local maxima."""
+    local = np.asarray(counts, np.int64).max(axis=1)
+    if allgather is None:
+        return [int(v) for v in local]
+    g = np.asarray(allgather(local)).max(axis=0)
+    return [int(v) for v in np.reshape(g, -1)]
+
+
 def single_process_allgather(x: np.ndarray) -> np.ndarray:
     return np.asarray(x)[None]
 

@@ -64,12 +64,15 @@ class RingPlans(NamedTuple):
     bwd_esrc: "np.ndarray"
 
 
-def build_ring_plans(rm: RingMaps, S: int) -> RingPlans:
-    """Chunk plans for every (shard, owner) group, padded to the global max
-    chunk count per direction (shard_map + the per-step jnp.take need one
-    static shape)."""
+def build_ring_plans(rm: RingMaps, S: int, allgather=None) -> RingPlans:
+    """Chunk plans for every (shard, owner) group, padded to the max chunk
+    count per direction (shard_map + the per-step jnp.take need one static
+    shape).  Under -perhost ``rm`` holds only this process's shards;
+    ``allgather`` raises the pad targets to the global per-direction
+    maxima so every process compiles the same program (the contract of
+    shard_load.allgather_floors)."""
     from roc_tpu.ops.pallas.segment_sum import build_chunk_plan, pad_chunks
-    P = rm.ring_src.shape[0]
+    L, P = rm.ring_src.shape[:2]
 
     def one(gather, scatter, rows):
         pl = build_chunk_plan(np.asarray(gather, np.int64),
@@ -81,7 +84,7 @@ def build_ring_plans(rm: RingMaps, S: int) -> RingPlans:
         return pl
 
     fwd, bwd = [], []
-    for p in range(P):
+    for p in range(L):
         for o in range(P):
             src, dst = rm.ring_src[p, o], rm.ring_dst[p, o]
             fwd.append(one(src, dst, S + 1))
@@ -90,41 +93,59 @@ def build_ring_plans(rm: RingMaps, S: int) -> RingPlans:
             # S hits the zero row), scatter onto buf rows (src ids)
             bwd.append(one(dst[order], src[order], S))
 
-    def stack(plans):
-        C = max(pl.obi.shape[0] for pl in plans)
+    from roc_tpu.graph.shard_load import allgather_floors
+    floors = allgather_floors(
+        [[pl.obi.shape[0] for pl in fwd], [pl.obi.shape[0] for pl in bwd]],
+        allgather)
+
+    def stack(plans, floor):
+        C = max(max(pl.obi.shape[0] for pl in plans), floor)
         padded = [pad_chunks(pl.obi, pl.first, pl.edst, pl.esrc,
                              C - pl.obi.shape[0], np) for pl in plans]
         out = []
         for i in range(4):
-            arr = np.stack([q[i] for q in padded])       # [P*P, ...]
-            out.append(arr.reshape((P, P) + arr.shape[1:]).astype(np.int32))
+            arr = np.stack([q[i] for q in padded])       # [L*P, ...]
+            out.append(arr.reshape((L, P) + arr.shape[1:]).astype(np.int32))
         return out
 
-    fo, _, fd, fs = stack(fwd)
-    bo, _, bd, bs = stack(bwd)
+    fo, _, fd, fs = stack(fwd, floors[0])
+    bo, _, bd, bs = stack(bwd, floors[1])
     return RingPlans(fwd_obi=fo, fwd_edst=fd, fwd_esrc=fs,
                      bwd_obi=bo, bwd_edst=bd, bwd_esrc=bs)
 
 
-def build_ring_groups(part: Partition) -> RingMaps:
-    """Group every shard's edges by source owner (vectorized NumPy)."""
-    P, S = part.num_parts, part.shard_nodes
-    E = part.edge_src.shape[1]
-    owner = (part.edge_src // S).astype(np.int64)            # [P, E]
-    counts = np.zeros((P, P), np.int64)
-    rows = np.repeat(np.arange(P), E)
-    np.add.at(counts, (rows, owner.reshape(-1)), 1)
-    Eo = max(int(counts.max()), 1)
+def build_ring_groups_arrays(edge_src: np.ndarray, edge_dst: np.ndarray,
+                             P: int, S: int, allgather=None) -> RingMaps:
+    """Group shards' edges by source owner (vectorized NumPy).
 
-    ring_src = np.zeros((P, P, Eo), np.int32)
-    ring_dst = np.full((P, P, Eo), S, np.int32)
+    ``edge_src`` [L, E] padded-global ids, ``edge_dst`` [L, E] shard-local
+    — L = locally-held shards (all P single-host; this process's parts
+    under -perhost).  ``allgather`` raises the group pad width Eo to the
+    global max so every process builds the same static shapes (None:
+    local max, the single-host case)."""
+    from roc_tpu.graph.shard_load import allgather_floors
+    L, E = edge_src.shape
+    owner = (edge_src // S).astype(np.int64)                 # [L, E]
+    counts = np.zeros((L, P), np.int64)
+    rows = np.repeat(np.arange(L), E)
+    np.add.at(counts, (rows, owner.reshape(-1)), 1)
+    Eo = max(allgather_floors([[int(counts.max(initial=0))]],
+                              allgather)[0], 1)
+
+    ring_src = np.zeros((L, P, Eo), np.int32)
+    ring_dst = np.full((L, P, Eo), S, np.int32)
     # stable grouping: position of each edge within its (p, owner) group
-    order = np.argsort(owner, axis=1, kind="stable")          # [P, E]
-    for p in range(P):
+    order = np.argsort(owner, axis=1, kind="stable")          # [L, E]
+    for p in range(L):
         o = owner[p, order[p]]
         starts = np.searchsorted(o, np.arange(P))
         pos = np.arange(E) - starts[o]
-        ring_src[p, o, pos] = (part.edge_src[p, order[p]] % S).astype(
-            np.int32)
-        ring_dst[p, o, pos] = part.edge_dst[p, order[p]].astype(np.int32)
+        ring_src[p, o, pos] = (edge_src[p, order[p]] % S).astype(np.int32)
+        ring_dst[p, o, pos] = edge_dst[p, order[p]].astype(np.int32)
     return RingMaps(ring_src=ring_src, ring_dst=ring_dst)
+
+
+def build_ring_groups(part: Partition) -> RingMaps:
+    """Single-host form: all P shards' groups from the full partition."""
+    return build_ring_groups_arrays(part.edge_src, part.edge_dst,
+                                    part.num_parts, part.shard_nodes)

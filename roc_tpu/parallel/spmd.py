@@ -529,17 +529,9 @@ def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
     return ops.pad_plans(plan_list, min_fwd=f[0], min_bwd=f[1])
 
 
-def _allgather_floors(counts, allgather) -> "list[int]":
-    """Cross-process static-shape floors: local per-side maxima →
-    allgather → global maxima.  Every process must compile the SAME
-    shard_map program, so per-shard pad targets take the global max chunk
-    count per side.  ``counts``: [n_sides][n_local_shards] ints;
-    ``allgather`` None (single-process) returns the local maxima."""
-    local = np.asarray(counts, np.int64).max(axis=1)
-    if allgather is None:
-        return [int(v) for v in local]
-    g = np.asarray(allgather(local)).max(axis=0)
-    return [int(v) for v in np.reshape(g, -1)]
+# Canonical home is graph.shard_load (the allgather utilities layer);
+# re-exported here for the in-module call sites and backward compat.
+from roc_tpu.graph.shard_load import allgather_floors as _allgather_floors  # noqa: E402,E501
 
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
@@ -1121,6 +1113,32 @@ class SpmdTrainer(BaseTrainer):
         self.part = meta
         part_ids = self._local_part_ids()
         local = shard_load.load_local_shards(path, meta, part_ids)
+        if self._exchange_mode == "ring":
+            # Ring × perhost (closes a round-3 documented fallback): every
+            # ring ingredient is LOCAL — a shard's edges grouped by source
+            # owner come straight from its own byte-range slice; only the
+            # static shapes (group pad width Eo, plan chunk counts) need
+            # cross-process agreement, via the same allgathered floors as
+            # the halo path.
+            from roc_tpu.parallel.ring import (build_ring_groups_arrays,
+                                               build_ring_plans)
+            self.halo = None
+            P_, S = meta.num_parts, meta.shard_nodes
+            rm = build_ring_groups_arrays(local.edge_src, local.edge_dst,
+                                          P_, S, allgather=ag)
+            ring_plans = None
+            if backend == "matmul":
+                rp = build_ring_plans(rm, S, allgather=ag)
+                ring_plans = jax.tree.map(jnp.asarray, rp)
+            return ShardedGraphData(
+                edge_src=jnp.asarray(local.edge_src, jnp.int32),
+                edge_dst=jnp.asarray(local.edge_dst, jnp.int32),
+                in_degree=jnp.asarray(local.in_degree, jnp.float32),
+                send_idx=None,
+                ring_src=jnp.asarray(rm.ring_src),
+                ring_dst=jnp.asarray(rm.ring_dst),
+                plans=None, ring_plans=ring_plans, backend=backend,
+                mode="ring", precision=cfg.aggregate_precision)
         lhalo = shard_load.build_halo_local(meta, local, ag) \
             if self._exchange_mode == "halo" else None
         self.halo = lhalo
@@ -1260,11 +1278,6 @@ class SpmdTrainer(BaseTrainer):
                       f"{self.mesh.devices.size} device(s), "
                       f"k={self.k} shard blocks per device "
                       f"(gnn.cc:61-63 numParts>numGPUs)", file=sys.stderr)
-        if self._exchange_mode == "ring" and cfg.perhost_load:
-            if jax.process_index() == 0:
-                print("# -exchange ring is incompatible with -perhost; "
-                      "using halo", file=sys.stderr)
-            self._exchange_mode = "halo"
         if cfg.perhost_load:
             if cfg.edge_shard in (True, "on") and jax.process_index() == 0:
                 print("# -edge-shard is incompatible with -perhost; using "

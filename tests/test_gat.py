@@ -111,11 +111,17 @@ def test_chunked_gat_matches_dense(monkeypatch):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_gat_plan_matches_dense_and_grads():
+@pytest.mark.parametrize("configs", [
+    # fast lane: one representative shape; the other shapes ride the slow
+    # lane (each config compiles 6 programs — value+grad for both impls)
+    [(3, 150, 3, 5)],
+    pytest.param([(7, 333, 1, 16), (11, 64, 4, 3)], marks=pytest.mark.slow),
+])
+def test_gat_plan_matches_dense_and_grads(configs):
     """Plan-backend attention (ops.gat_attend_plan — scatter-free chunk-plan
     softmax/aggregation) must match the dense oracle in value and in every
     gradient (its backward is hand-derived, not autodiff)."""
-    for seed, n, K, F in [(3, 150, 3, 5), (7, 333, 1, 16), (11, 64, 4, 3)]:
+    for seed, n, K, F in configs:
         ds = datasets.synthetic("t", n, 4.0, 8, 4, n_train=10, n_val=10,
                                 n_test=10, seed=seed)
         g = ds.graph
@@ -182,6 +188,7 @@ def test_gat_plan_multistep_scan_matches_oracle():
                                rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_gat_plan_training_matches_xla():
     """End-to-end GAT training with -aggr-backend matmul (which routes
     attention through the plan backend) must track the xla-backend run."""
@@ -303,6 +310,7 @@ def test_gat_edge_shard_plan_equals_single_and_scatter_free():
     assert not hits, f"compiled step still contains {len(hits)} scatter ops"
 
 
+@pytest.mark.slow
 def test_gat_plan_perhost_equals_full_load(tmp_path):
     """Plan attention under -perhost (per-host `.lux` slice loading):
     the per-host-built, floor-padded plans must train identically to the
@@ -342,7 +350,10 @@ def test_gat_training_learns():
     assert int(m.train_correct) / max(int(m.train_all), 1) > 0.6
 
 
-@pytest.mark.parametrize("halo", [False, True])
+@pytest.mark.parametrize("halo", [
+    # all_gather exchange rides the slow lane: same code path shape as
+    # halo, and every non-GAT sharded test covers halo=False fast
+    pytest.param(False, marks=pytest.mark.slow), True])
 def test_gat_sharded_equals_single(halo):
     ds, g, _ = graph_and_x(n=220)
     layers = [ds.in_dim, 6, ds.num_classes]

@@ -5,7 +5,10 @@
         [--labels l.txt] [--mask m.txt] [--num-nodes N] [--undirected]
         [--split TR,VA,TE] [--seed S] -o out/prefix
     python tools/convert.py ogb --dir ogbn_arxiv/raw -o out/prefix
-    python tools/convert.py karate -o out/prefix
+    python tools/convert.py mtx --file graph.mtx -o out/prefix
+    python tools/convert.py karate -o out/prefix    # vendored real graphs:
+    python tools/convert.py davis -o out/prefix     # data/*/README.md
+    python tools/convert.py lesmis -o out/prefix
 
 Output: ``<prefix>.add_self_edge.lux`` + ``.feats.csv``/``.label``/``.mask``
 sidecars — the exact byte layout the reference's loaders consume
