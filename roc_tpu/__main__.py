@@ -39,11 +39,10 @@ def main(argv=None) -> int:
               "exclusive distribution strategies", file=sys.stderr)
         return 2
     if cfg.edge_shard in (True, "on") and (
-            cfg.num_parts < 2 or cfg.perhost_load
-            or cfg.aggr in ("max", "min")):
-        print("error: -edge-shard supports sum/avg aggregation, needs "
-              "-parts > 1, and is incompatible with -perhost",
-              file=sys.stderr)
+            cfg.num_parts < 2 or cfg.aggr in ("max", "min")):
+        print("error: -edge-shard supports sum/avg aggregation and needs "
+              "-parts > 1 (since round 4 it composes with -perhost given "
+              "the .t.lux transposed sidecar)", file=sys.stderr)
         return 2
     if cfg.perhost_load and cfg.check_sharding:
         # the checker's single-device reference needs the whole graph on one

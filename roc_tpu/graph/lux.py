@@ -33,6 +33,11 @@ _MASK_NAMES = {"Train": MASK_TRAIN, "Val": MASK_VAL, "Test": MASK_TEST, "None": 
 _MASK_STRS = {v: k for k, v in _MASK_NAMES.items()}
 
 LUX_SUFFIX = ".add_self_edge.lux"
+# Transposed-graph sidecar (out-edge CSR over sources) — the preprocessed
+# input edge-sharded -perhost loading needs for its src-sorted backward
+# blocks (shard_load.load_edge_blocks).  Produced once offline, the same
+# pattern as the reference's *.add_self_edge.lux preprocessing itself.
+TLUX_SUFFIX = ".add_self_edge.t.lux"
 
 
 def read_header(path: str) -> "tuple[int, int]":
@@ -102,6 +107,13 @@ def write_lux(path: str, g: Csr) -> None:
         np.asarray([g.num_edges], dtype=np.uint64).tofile(f)
         g.row_ptr[1:].astype(np.uint64).tofile(f)
         g.col_idx.astype(np.uint32).tofile(f)
+
+
+def write_transpose(prefix: str, g: Csr) -> None:
+    """Write the transposed-graph sidecar (``prefix + TLUX_SUFFIX``).
+    One offline O(E log E) sort buys -edge-shard -perhost its src-sorted
+    backward blocks as plain byte-range reads."""
+    write_lux(prefix + TLUX_SUFFIX, g.transpose())
 
 
 def _cache_fresh(bin_path: str, src_path: str) -> bool:

@@ -186,6 +186,96 @@ def load_local_shards(path: str, meta: PartitionMeta,
                        node_mask=node_mask)
 
 
+def load_local_degrees(path: str, meta: PartitionMeta,
+                       part_ids: Sequence[int]) -> np.ndarray:
+    """[L, S] in-degrees for this process's parts (1.0 on pad rows) —
+    the slice of Partition.in_degree edge-sharded -perhost needs without
+    paying load_local_shards' cols reads (edge mode loads edges by BLOCK,
+    not by part)."""
+    L, S = len(part_ids), meta.shard_nodes
+    in_degree = np.ones((L, S), dtype=np.float32)
+    for i, p in enumerate(part_ids):
+        lo, hi = meta.bounds[p]
+        n = int(meta.num_valid[p])
+        if n > 0:
+            e0 = int(meta.edge_starts[p])
+            ends = read_rows_slice(path, lo, hi + 1).astype(np.int64)
+            in_degree[i, :n] = np.diff(
+                np.concatenate([[e0], ends])).astype(np.float32)
+    return in_degree
+
+
+def _bisect_rows(path: str, target: int, num_nodes: int) -> int:
+    """Smallest vertex v whose inclusive end offset raw_rows[v] > target —
+    i.e. the vertex whose CSR range contains edge index ``target``.
+    O(log N) 8-byte file reads; no O(N) array is ever resident (the point
+    of per-host loading)."""
+    lo, hi = 0, num_nodes          # invariant: answer in [lo, hi]
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if int(read_rows_slice(path, mid, mid + 1)[0]) > target:
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo
+
+
+def load_edge_blocks(path: str, meta: PartitionMeta,
+                     block_ids: Sequence[int]):
+    """This process's blocks of the exactly-edge-balanced edge cut —
+    byte-identical to ``edge_block_arrays(g, meta)[block_ids]`` (the
+    single-host builder; tests pin the equality) but reading ONLY the
+    blocks' `.lux` byte ranges: the dst-sorted edge list IS the on-disk
+    cols section, so block b is cols [b*Eb, (b+1)*Eb) plus the covering
+    slice of row offsets (located by binary search over the offset
+    section).  Pass the ``TLUX_SUFFIX`` file to get the transposed
+    (src-sorted) blocks the backward plans need — with the SAME ``meta``
+    (the vertex partition lives on the original orientation).
+
+    Returns (gather [L, Eb], scatter [L, Eb]) padded-global int64."""
+    from roc_tpu.graph.partition import _EDGE_ALIGN, _round_up
+    P, S = meta.num_parts, meta.shard_nodes
+    E = meta.num_edges
+    num_nodes, num_edges_f = read_header(path)
+    if num_nodes != meta.num_nodes or num_edges_f != E:
+        raise ValueError(
+            f"{path}: header ({num_nodes}, {num_edges_f}) != meta "
+            f"({meta.num_nodes}, {E}) — wrong/mismatched transpose "
+            f"sidecar?")
+    Eb = _round_up(-(-E // P), _EDGE_ALIGN)
+    L = len(block_ids)
+    gather = np.zeros((L, Eb), dtype=np.int64)
+    scatter = np.zeros((L, Eb), dtype=np.int64)
+    to_padded = meta.to_padded
+
+    for i, b in enumerate(block_ids):
+        # a late block can start past E entirely (small E, many parts):
+        # its row is ALL pad edges, like edge_block_arrays' tail padding
+        e0 = b * Eb
+        ne = max(min((b + 1) * Eb, E) - e0, 0)
+        e1 = e0 + ne
+        if ne > 0:
+            src_global = read_cols_slice(path, num_nodes, e0,
+                                         e1).astype(np.int64)
+            # vertices whose ranges intersect [e0, e1): v0 owns edge e0
+            v0 = _bisect_rows(path, e0, num_nodes)
+            v1 = _bisect_rows(path, e1 - 1, num_nodes)
+            ends = read_rows_slice(path, v0, v1 + 1).astype(np.int64)
+            starts = np.concatenate(
+                [read_rows_slice(path, v0 - 1, v0).astype(np.int64)
+                 if v0 else np.zeros(1, np.int64), ends[:-1]])
+            deg_in_blk = (np.minimum(ends, e1)
+                          - np.maximum(starts, e0)).clip(min=0)
+            dst_global = np.repeat(np.arange(v0, v1 + 1), deg_in_blk)
+            gather[i, :ne] = to_padded(src_global)
+            scatter[i, :ne] = to_padded(dst_global)
+        # pad edges: identical recipe to edge_block_arrays — src = part 0's
+        # first pad row (zero features), dst = the global last pad row
+        gather[i, ne:] = int(meta.num_valid[0])
+        scatter[i, ne:] = P * S - 1
+    return gather, scatter
+
+
 @dataclasses.dataclass(frozen=True)
 class LocalHalo:
     """This process's rows of the halo maps (cf. parallel/halo.py HaloMaps:

@@ -26,6 +26,7 @@ ICI within a slice and DCN across slices.
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 from functools import partial
 from typing import Optional
@@ -111,19 +112,22 @@ jax.tree_util.register_dataclass(
     meta_fields=["span_fwd", "span_bwd"])
 
 
-def _windowed_block_plans(gather, scatter, NS: int):
+def _windowed_block_plans(gather, scatter, NS: int, allgather=None):
     """Per-block chunk plans over each block's contiguous scatter window.
 
-    gather/scatter: [P, Eb] padded-global ids, scatter nondecreasing per
-    block.  Returns (obi, first, edst, esrc stacked [P, C(, EB)],
-    base [P], span)."""
+    gather/scatter: [L, Eb] padded-global ids, scatter nondecreasing per
+    block (L = local blocks; all P single-host).  Returns (obi, first,
+    edst, esrc stacked [L, C(, EB)], base [L], span).  ``allgather``
+    raises the static shapes (span, chunk count C) to the global maxima —
+    the -perhost contract of shard_load.allgather_floors."""
     from roc_tpu.ops.pallas.segment_sum import VB, build_chunk_plan, \
         pad_chunks
 
-    P_ = scatter.shape[0]
+    L_ = scatter.shape[0]
     bases = (scatter.min(axis=1) // VB) * VB
     span = int((scatter.max(axis=1) + 1 - bases).max())
-    span = min(-(-span // VB) * VB, NS)
+    span = min(-(-_allgather_floors([[span]], allgather)[0] // VB) * VB,
+               NS)
     # The accumulator has exactly NS rows, so base + span <= NS must hold
     # (dynamic_update_slice would otherwise clamp the start and shift the
     # block's sums onto wrong rows).  Relative ids still fit: scatter.max
@@ -132,10 +136,11 @@ def _windowed_block_plans(gather, scatter, NS: int):
     plans = [build_chunk_plan(
         np.asarray(gather[p], np.int32),
         np.asarray(scatter[p] - bases[p], np.int32), span)
-        for p in range(P_)]
+        for p in range(L_)]
     for pl in plans:   # same invariant build_aggregate_plans pins
         assert np.all(np.diff(np.asarray(pl.obi)) <= 1)
-    C = max(pl.obi.shape[0] for pl in plans)
+    C = _allgather_floors([[pl.obi.shape[0] for pl in plans]],
+                          allgather)[0]
     padded = [pad_chunks(pl.obi, pl.first, pl.edst, pl.esrc,
                          C - pl.obi.shape[0], jnp) for pl in plans]
     stack = [jnp.stack([q[i] for q in padded]) for i in range(4)]
@@ -147,12 +152,21 @@ def build_edge_plans(graph, meta, fwd_arrays=None) -> EdgePlans:
     """Fwd + transposed-bwd windowed plans for edge-sharded aggregation.
     ``fwd_arrays``: pass an existing edge_block_arrays(graph, meta) result
     to skip rebuilding it."""
-    NS = meta.num_parts * meta.shard_nodes
+    b_gat, b_sct = edge_block_arrays_t(graph, meta)
     f_gat, f_sct = fwd_arrays if fwd_arrays is not None \
         else edge_block_arrays(graph, meta)
-    b_gat, b_sct = edge_block_arrays_t(graph, meta)
-    fo, ff, fd, fs, fb, span_f = _windowed_block_plans(f_gat, f_sct, NS)
-    bo, bf, bd, bs, bb, span_b = _windowed_block_plans(b_gat, b_sct, NS)
+    return build_edge_plans_arrays(meta, f_gat, f_sct, b_gat, b_sct)
+
+
+def build_edge_plans_arrays(meta, f_gat, f_sct, b_gat, b_sct,
+                            allgather=None) -> EdgePlans:
+    """EdgePlans from prebuilt (or per-host byte-range-loaded) block
+    arrays; ``allgather`` makes the static shapes globally consistent."""
+    NS = meta.num_parts * meta.shard_nodes
+    fo, ff, fd, fs, fb, span_f = _windowed_block_plans(f_gat, f_sct, NS,
+                                                       allgather)
+    bo, bf, bd, bs, bb, span_b = _windowed_block_plans(b_gat, b_sct, NS,
+                                                       allgather)
     return EdgePlans(fwd_obi=fo, fwd_first=ff, fwd_edst=fd, fwd_esrc=fs,
                      fwd_base=fb, bwd_obi=bo, bwd_first=bf, bwd_edst=bd,
                      bwd_esrc=bs, bwd_base=bb,
@@ -331,19 +345,28 @@ def build_edge_gat_plans(graph, meta, fwd_arrays=None) -> EdgeGatPlans:
     """Host-side schedules for :func:`edge_gat_attend` — dst- and src-keyed
     edge-position plans per block, windows local to each block's id span
     (the GatPlans analog of build_edge_plans)."""
+    es, ed = fwd_arrays if fwd_arrays is not None \
+        else edge_block_arrays(graph, meta)       # [P, Eb] global, dst-sorted
+    return build_edge_gat_plans_arrays(meta, es, ed)
+
+
+def build_edge_gat_plans_arrays(meta, es, ed,
+                                allgather=None) -> EdgeGatPlans:
+    """EdgeGatPlans from prebuilt (or per-host byte-range-loaded) block
+    arrays; ``allgather`` raises window spans and chunk counts to the
+    global maxima (the -perhost static-shape contract)."""
     from roc_tpu.ops.edge import GatPlans, _position_plan, pad_gat_plans
     from roc_tpu.ops.pallas.segment_sum import VB
     NS = meta.num_parts * meta.shard_nodes
-    es, ed = fwd_arrays if fwd_arrays is not None \
-        else edge_block_arrays(graph, meta)       # [P, Eb] global, dst-sorted
     es = np.asarray(es, np.int64)
     ed = np.asarray(ed, np.int64)
-    P_, Eb = es.shape
+    L_, Eb = es.shape
 
     def window(keys):
         base = (keys.min(axis=1) // VB) * VB
         span = int((keys.max(axis=1) + 1 - base).max())
-        span = min(-(-span // VB) * VB, NS)
+        span = min(-(-_allgather_floors([[span]], allgather)[0] // VB)
+                   * VB, NS)
         return np.minimum(base, NS - span), span
 
     dbase, span_d = window(ed)
@@ -351,14 +374,16 @@ def build_edge_gat_plans(graph, meta, fwd_arrays=None) -> EdgeGatPlans:
     es_sorted = np.take_along_axis(es, orders, axis=1)
     sbase, span_s = window(es_sorted)
     plans = []
-    for p in range(P_):
+    for p in range(L_):
         pos = np.arange(Eb, dtype=np.int64)
         d = _position_plan(ed[p] - dbase[p], pos, es[p], span_d)
         s = _position_plan(es_sorted[p] - sbase[p], orders[p], ed[p],
                            span_s)
         plans.append(GatPlans(*(jnp.asarray(a) for a in d + s),
                               num_rows=span_d, table_rows=span_s))
-    return EdgeGatPlans(plans=pad_gat_plans(plans),
+    f = _allgather_floors([[p.dst_obi.shape[0] for p in plans],
+                           [p.src_obi.shape[0] for p in plans]], allgather)
+    return EdgeGatPlans(plans=pad_gat_plans(plans, min_d=f[0], min_s=f[1]),
                         dst_base=jnp.asarray(dbase, jnp.int32),
                         src_base=jnp.asarray(sbase, jnp.int32))
 
@@ -1112,6 +1137,60 @@ class SpmdTrainer(BaseTrainer):
                                         jax.process_index(), ag)
         self.part = meta
         part_ids = self._local_part_ids()
+        if self._use_edge_shard:
+            # Edge-shard × perhost (round 4, the last loading × mode cell):
+            # the dst-sorted edge list IS the on-disk cols section, so the
+            # exactly-edge-balanced fwd blocks are plain byte-range reads;
+            # the src-sorted bwd blocks read the transposed sidecar
+            # (prefix + TLUX_SUFFIX, written offline by lux.write_transpose
+            # — the same preprocessing pattern as *.add_self_edge.lux
+            # itself).  Only static shapes (window spans, chunk counts) are
+            # allgathered.
+            self.halo = None
+            f_gat, f_sct = shard_load.load_edge_blocks(path, meta, part_ids)
+            assert meta.num_parts * meta.shard_nodes < 2**31
+            if backend == "binned":
+                if jax.process_index() == 0:
+                    print("# -edge-shard -perhost rides the matmul "
+                          "windowed plans (binned block windows need the "
+                          "whole graph's occupancy stats)", file=sys.stderr)
+                backend = "matmul"
+            plans = None
+            if backend == "matmul":
+                # bwd (src-sorted) blocks come from the transposed sidecar
+                tpath = cfg.filename + lux.TLUX_SUFFIX
+                if not os.path.exists(tpath):
+                    raise FileNotFoundError(
+                        f"-edge-shard -perhost needs the transposed "
+                        f"sidecar {tpath}; generate it once with "
+                        f"roc_tpu.graph.lux.write_transpose(prefix, graph)"
+                        f" or tools/convert.py --with-transpose")
+                if os.path.getmtime(tpath) < os.path.getmtime(path):
+                    # same freshness rule as the .feats.bin cache
+                    # (lux._cache_fresh): a regenerated graph with equal
+                    # N/E would otherwise pair new fwd blocks with stale
+                    # bwd blocks — silently wrong gradients
+                    raise ValueError(
+                        f"{tpath} is older than {path}: regenerate the "
+                        f"transposed sidecar (tools/convert.py "
+                        f"--with-transpose or lux.write_transpose)")
+                b_gat, b_sct = shard_load.load_edge_blocks(tpath, meta,
+                                                           part_ids)
+                plans = build_edge_plans_arrays(meta, f_gat, f_sct, b_gat,
+                                                b_sct, allgather=ag)
+            gat_plans = None
+            if gat_backend == "plan":
+                gat_plans = build_edge_gat_plans_arrays(
+                    meta, f_gat, f_sct, allgather=ag)
+            return ShardedGraphData(
+                edge_src=jnp.asarray(f_gat, jnp.int32),
+                edge_dst=jnp.asarray(f_sct, jnp.int32),
+                in_degree=jnp.asarray(
+                    shard_load.load_local_degrees(path, meta, part_ids),
+                    jnp.float32),
+                send_idx=None, plans=plans, gat_plans=gat_plans,
+                backend=backend, mode="edge",
+                precision=cfg.aggregate_precision)
         local = shard_load.load_local_shards(path, meta, part_ids)
         if self._exchange_mode == "ring":
             # Ring × perhost (closes a round-3 documented fallback): every
@@ -1279,9 +1358,18 @@ class SpmdTrainer(BaseTrainer):
                       f"k={self.k} shard blocks per device "
                       f"(gnn.cc:61-63 numParts>numGPUs)", file=sys.stderr)
         if cfg.perhost_load:
-            if cfg.edge_shard in (True, "on") and jax.process_index() == 0:
-                print("# -edge-shard is incompatible with -perhost; using "
-                      "vertex sharding", file=sys.stderr)
+            # Explicit -edge-shard composes with -perhost since round 4
+            # (blocks are byte-range reads; bwd needs the transposed
+            # sidecar).  "auto" stays off here: the tax heuristic wants
+            # the partition stats before any loading is done, and the
+            # transposed sidecar may not exist — opt in explicitly.
+            self._use_edge_shard = cfg.edge_shard in (True, "on")
+            if self._use_edge_shard and self._model_has_gat() \
+                    and self._gat_backend() != "plan":
+                raise ValueError(
+                    "-edge-shard -perhost with a GAT model needs the plan "
+                    "attention backend (-aggr-backend matmul/binned); the "
+                    "xla path's _edge_attend serializes on TPU")
         else:
             self.part = partition_graph(ds.graph, P_)
             self._use_edge_shard = self._resolve_edge_shard()

@@ -77,6 +77,10 @@ def main(argv=None) -> int:
     for s in sub.choices.values():
         s.add_argument("-o", "--out", required=True,
                        help="output path prefix")
+        s.add_argument("--with-transpose", action="store_true",
+                       help="also write the transposed-graph sidecar "
+                            "(.t.lux) that -edge-shard -perhost loading "
+                            "needs for its backward blocks")
 
     a = p.parse_args(argv)
     if a.cmd == "edgelist":
@@ -105,6 +109,11 @@ def main(argv=None) -> int:
     else:
         ds = convert.karate_club()
     convert.write(ds, a.out)
+    if a.with_transpose:
+        from roc_tpu.graph import lux
+        lux.write_transpose(a.out, ds.graph)
+        print(f"wrote {a.out}{lux.TLUX_SUFFIX} (transposed sidecar)",
+              file=sys.stderr)
     print(f"wrote {a.out}.add_self_edge.lux + sidecars: "
           f"{ds.graph.num_nodes} nodes, {ds.graph.num_edges} edges "
           f"(self-edges incl.), in_dim={ds.in_dim}, "
