@@ -141,6 +141,12 @@ def _default_geom() -> Geometry:
 #            gbuf 2x2048xH, p2 one-hot (2048x1024 bf16) 4 MB + rb*H out.
 GEOM_MID = Geometry(sb=512, ch=2048, slot=32, rb=512, ch2=4096)
 GEOM_SPARSE = Geometry(sb=1024, ch=2048, slot=16, rb=1024, ch2=2048)
+# Ultra-sparse: 2048-row windows quarter the cell count again; ch/ch2
+# shrink to keep the one-hot intermediates inside VMEM (t = 1024x2048
+# bf16 = 4 MB, phase-2 s_t likewise).  4096*H MACs per edge — only wins
+# where the occupancy stats say every smaller window drowns in slot
+# padding, which is exactly what the cost model weighs.
+GEOM_XSPARSE = Geometry(sb=2048, ch=1024, slot=16, rb=2048, ch2=1024)
 
 # Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).  Fewer
 # groups = less per-(group, block) chunk-rounding padding in phase 1 at the
@@ -273,7 +279,7 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     if E == 0:
         return None, 0.0
     cands = list(candidates) if candidates is not None else \
-        [_default_geom(), GEOM_MID, GEOM_SPARSE]
+        [_default_geom(), GEOM_MID, GEOM_SPARSE, GEOM_XSPARSE]
     best, best_t = None, float("inf")
     stats_cache = {}
     for g in cands:

@@ -287,7 +287,7 @@ def test_native_plan_equals_numpy_nondefault_geometry():
     if not native.available():
         pytest.skip("native library unavailable")
     rng = np.random.default_rng(17)
-    for geom in (B.GEOM_MID, B.GEOM_SPARSE):
+    for geom in (B.GEOM_MID, B.GEOM_SPARSE, B.GEOM_XSPARSE):
         for (n, t, e) in [(700, 700, 5000), (3 * geom.rb, 1000, 3000),
                           (5000, 4000, 120000), (100, 100, 0)]:
             src = rng.integers(0, t, e).astype(np.int64)
@@ -433,12 +433,13 @@ def test_auto_binned_shard_level_refinement(monkeypatch):
     assert np.isfinite(float(tr.run_epoch()))
 
 
-@pytest.mark.parametrize("geom_name", ["mid", "sparse"])
+@pytest.mark.parametrize("geom_name", ["mid", "sparse", "xsparse"])
 def test_binned_nondefault_geometry_matches_oracle(geom_name):
     """The sparse-graph geometry presets (VERDICT r3 item 3) must produce
     oracle-correct sums through the same kernels, fast and exact."""
     from roc_tpu.ops.pallas import binned as B
-    geom = {"mid": B.GEOM_MID, "sparse": B.GEOM_SPARSE}[geom_name]
+    geom = {"mid": B.GEOM_MID, "sparse": B.GEOM_SPARSE,
+            "xsparse": B.GEOM_XSPARSE}[geom_name]
     rng = np.random.default_rng(21)
     for (n, t, e, h) in [(700, 700, 5000, 64),
                          (1500, 2000, 12000, 41),    # lane-unaligned H,

@@ -187,9 +187,9 @@ def test_perhost_edge_blocks_equal_singlehost(roc_dir, num_parts, nproc):
     equal the single-host EdgePlans rows."""
     from roc_tpu.graph.partition import (edge_block_arrays,
                                          edge_block_arrays_t)
-    from roc_tpu.parallel.spmd import (build_edge_plans,
+    from roc_tpu.parallel.spmd import (build_edge_gat_plans_arrays,
+                                       build_edge_plans,
                                        build_edge_plans_arrays)
-    import jax
 
     prefix, ds = roc_dir
     path = prefix + lux.LUX_SUFFIX
@@ -201,9 +201,11 @@ def test_perhost_edge_blocks_equal_singlehost(roc_dir, num_parts, nproc):
     b_full = edge_block_arrays_t(ds.graph, part.meta)
     plans_full = build_edge_plans(ds.graph, part.meta,
                                   fwd_arrays=f_full)
+    gat_full = build_edge_gat_plans_arrays(part.meta, f_full[0], f_full[1])
 
     L = num_parts // nproc
     ag = ThreadAllGather(nproc)
+    ag2 = ThreadAllGather(nproc)
 
     def per_process(i):
         allg = ag.for_process(i)
@@ -214,9 +216,12 @@ def test_perhost_edge_blocks_equal_singlehost(roc_dir, num_parts, nproc):
         b = shard_load.load_edge_blocks(tpath, meta, block_ids)
         plans = build_edge_plans_arrays(meta, f[0], f[1], b[0], b[1],
                                         allgather=allg)
-        return block_ids, f, b, plans
+        gat = build_edge_gat_plans_arrays(meta, f[0], f[1],
+                                          allgather=ag2.for_process(i))
+        return block_ids, f, b, plans, gat
 
-    for ids, (fg, fs), (bg, bs), plans in _run_threads(nproc, per_process):
+    for ids, (fg, fs), (bg, bs), plans, gat in _run_threads(nproc,
+                                                            per_process):
         np.testing.assert_array_equal(fg, f_full[0][ids])
         np.testing.assert_array_equal(fs, f_full[1][ids])
         np.testing.assert_array_equal(bg, b_full[0][ids])
@@ -229,6 +234,19 @@ def test_perhost_edge_blocks_equal_singlehost(roc_dir, num_parts, nproc):
             np.testing.assert_array_equal(
                 np.asarray(getattr(plans, f)),
                 np.asarray(getattr(plans_full, f))[ids], err_msg=f)
+        # EdgeGatPlans parity too (the plan-backend attention cell):
+        # identical spans and per-block rows across processes
+        assert gat.plans.num_rows == gat_full.plans.num_rows
+        assert gat.plans.table_rows == gat_full.plans.table_rows
+        np.testing.assert_array_equal(np.asarray(gat.dst_base),
+                                      np.asarray(gat_full.dst_base)[ids])
+        np.testing.assert_array_equal(np.asarray(gat.src_base),
+                                      np.asarray(gat_full.src_base)[ids])
+        for f in ("dst_obi", "dst_edst", "dst_pos", "dst_nid",
+                  "src_obi", "src_edst", "src_pos", "src_nid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(gat.plans, f)),
+                np.asarray(getattr(gat_full.plans, f))[ids], err_msg=f)
 
 
 def test_edge_blocks_all_pad_tail(tmp_path):
