@@ -218,12 +218,17 @@ def binned_viable(num_rows: int, table_rows: int, num_edges: int) -> bool:
 # Cost-model calibration, measured on v5e at Reddit shape (docs/PERF.md,
 # 2026-07-31): both phases are per-grid-step-overhead-bound at ~10/12 us
 # per chunk, with the one-hot MACs sustaining ~35-44% of the 197 TF/s bf16
-# peak when they dominate.  t_phase = max(MAC time, chunk overhead); the
-# matmul backend's cost is its issue-rate-bound row gather (~10 ns/row,
-# H-independent up to ~128 lanes) plus its cheap VB=8 one-hot dots —
-# calibrated end to end: 23.5M edges -> 351 ms measured = 15 ns/edge.
+# peak when they dominate; phase 1 additionally pays a per-slot-DMA issue
+# cost — the SLOT sweep's own signal (32 -> 128 saved 19.3 ms on ~624k
+# fewer DMAs at equal padded rows = ~31 ns per slot DMA), without which
+# the model would mis-rank small-slot presets above the measured SLOT=128
+# winner on dense graphs.  t_phase1 = max(MAC, chunk overhead) + slot-DMA
+# issue; the matmul backend's cost is its issue-rate-bound row gather
+# (~10 ns/row, H-independent up to ~128 lanes) plus its cheap VB=8
+# one-hot dots — calibrated end to end: 23.5M edges -> 351 ms = 15 ns/edge.
 _MXU_EFF_FLOPS = 69e12        # 35% of v5e bf16 peak (phase-1 measured)
 _CHUNK_OVERHEAD_S = 11e-6     # per grid step (9.6-12.2 us measured)
+_SLOT_DMA_S = 31e-9           # per staging slot DMA (SLOT sweep delta)
 _MATMUL_NS_PER_EDGE = 15.0
 _MODEL_H = 256                # nominal width: plans are H-independent
 
@@ -236,7 +241,8 @@ def _binned_cost_model(padded_rows: int, geom: Geometry,
     mac2 = padded_rows * geom.rb * H * 2 / _MXU_EFF_FLOPS
     ov1 = padded_rows / geom.ch * _CHUNK_OVERHEAD_S
     ov2 = padded_rows / geom.ch2 * _CHUNK_OVERHEAD_S
-    return max(mac1, ov1) + max(mac2, ov2)
+    dma1 = padded_rows / geom.slot * _SLOT_DMA_S
+    return max(mac1, ov1) + dma1 + max(mac2, ov2)
 
 
 def _cell_counts(edge_src: np.ndarray, edge_dst: np.ndarray,

@@ -273,6 +273,37 @@ def test_edge_blocks_all_pad_tail(tmp_path):
     np.testing.assert_array_equal(got[1], full[1])
 
 
+def test_edge_blocks_fuzz_equal_singlehost(tmp_path):
+    """Property fuzz for the byte-range block loader: random graph shapes
+    and part counts (incl. self-loop-only rows, hubs, P not dividing E)
+    must reproduce edge_block_arrays on BOTH orientations bit for bit."""
+    from roc_tpu.graph.partition import edge_block_arrays, partition_graph
+    rng = np.random.default_rng(31)
+    for trial in range(5):
+        n = int(rng.integers(40, 900))
+        e = int(rng.integers(0, 4000))
+        P = int(rng.choice([2, 3, 4, 7, 8, 16]))
+        src = rng.integers(0, n, e)
+        dst = rng.integers(0, n, e)
+        if e > 50 and trial % 2:
+            dst[: e // 3] = int(rng.integers(0, n))   # hub
+        from roc_tpu.graph.csr import add_self_edges, from_edges
+        g = add_self_edges(from_edges(n, src, dst))
+        prefix = str(tmp_path / f"f{trial}")
+        lux.write_lux(prefix + lux.LUX_SUFFIX, g)
+        lux.write_transpose(prefix, g)
+        part = partition_graph(g, P)
+        meta = shard_load.meta_from_lux(prefix + lux.LUX_SUFFIX, P)
+        for path, full in [
+                (prefix + lux.LUX_SUFFIX, edge_block_arrays(g, part.meta)),
+                (prefix + lux.TLUX_SUFFIX,
+                 edge_block_arrays(g.transpose(), part.meta))]:
+            got = shard_load.load_edge_blocks(path, meta, list(range(P)))
+            msg = f"trial {trial}: n={n} e={e} P={P} {path[-6:]}"
+            np.testing.assert_array_equal(got[0], full[0], err_msg=msg)
+            np.testing.assert_array_equal(got[1], full[1], err_msg=msg)
+
+
 def test_perhost_edge_shard_trains_equal_full(roc_dir):
     """End to end: -edge-shard -perhost (single process) trains
     identically to the full-load edge-sharded run."""
