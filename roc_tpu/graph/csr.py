@@ -67,7 +67,22 @@ class Csr:
     def transpose(self) -> "Csr":
         """Out-edge view as a CSR over sources (used by aggregation backward:
         the reference reuses the same kernel with roles swapped,
-        scattergather_kernel.cu:160-170)."""
+        scattergather_kernel.cu:160-170).  Big graphs take the native
+        O(E) counting sort (roc_csr_transpose — stable, so element-equal
+        to this NumPy stable-argsort oracle; ~30-60 s -> seconds at
+        products scale, on the reorder and .t.lux preprocessing paths)."""
+        from roc_tpu import native
+        if self.num_edges >= (1 << 20) and native.available():
+            # range-check first: the NumPy path fails loudly on corrupt
+            # ids (bincount/cumsum raise); the C counting sort would
+            # index out of bounds instead
+            if int(self.col_idx.min()) < 0 or \
+                    int(self.col_idx.max()) >= self.num_nodes:
+                raise ValueError("col_idx out of range [0, num_nodes)")
+            t_row, t_col = native.csr_transpose(self.row_ptr, self.col_idx)
+            return Csr(self.num_nodes, self.num_edges,
+                       t_row.astype(E_DTYPE, copy=False),
+                       t_col.astype(V_DTYPE, copy=False))
         order = np.argsort(self.col_idx, kind="stable")
         new_col = self.dst_idx[order].astype(V_DTYPE)
         counts = np.bincount(self.col_idx, minlength=self.num_nodes)

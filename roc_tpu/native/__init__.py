@@ -107,6 +107,9 @@ def _bind(L: ctypes.CDLL) -> None:
     L.roc_rcm_order.argtypes = [i64p, i32p, i64p, i32p, ctypes.c_int64,
                                 i64p]
     L.roc_rcm_order.restype = ctypes.c_int
+    L.roc_csr_transpose.argtypes = [i64p, i32p, ctypes.c_int64,
+                                    ctypes.c_int64, i64p, i32p]
+    L.roc_csr_transpose.restype = ctypes.c_int
 
 
 def available() -> bool:
@@ -314,3 +317,20 @@ def rcm_order(row_ptr: np.ndarray, col_idx: np.ndarray,
     if rc != 0:
         raise RuntimeError(f"roc_rcm_order rc={rc}")
     return out
+
+
+def csr_transpose(row_ptr: np.ndarray, col_idx: np.ndarray):
+    """Stable O(E) CSR transpose (see Csr.transpose) — returns
+    (t_row_ptr [N+1] int64, t_col_idx [E] int32), element-identical to
+    the NumPy stable-argsort oracle."""
+    L = lib()
+    assert L is not None
+    N, E = len(row_ptr) - 1, len(col_idx)
+    t_row = np.empty(N + 1, np.int64)
+    t_col = np.empty(E, np.int32)
+    rc = L.roc_csr_transpose(np.ascontiguousarray(row_ptr, np.int64),
+                             np.ascontiguousarray(col_idx, np.int32),
+                             N, E, t_row, t_col)
+    if rc != 0:
+        raise RuntimeError(f"roc_csr_transpose rc={rc}")
+    return t_row, t_col
