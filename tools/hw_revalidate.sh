@@ -75,5 +75,10 @@ done
 note "4. constant sweep round 2"
 timeout 5400 python tools/sweep_binned.py 2>&1 | tee -a "$LOG"
 
+note "4b. sparse-preset sweep at products shape (re-fit choose_geometry's"
+note "    cost model constants from whatever this measures)"
+SWEEP_SHAPE=products SWEEP_N=2449029 SWEEP_E=125000000 SWEEP_TIMEOUT_S=1800 \
+    timeout 6000 python tools/sweep_binned.py 2>&1 | tee -a "$LOG"
+
 note "done — record winners in docs/PERF.md + BASELINE.md, update"
 note "ROC_BINNED_GROUP_ROWS default / native BN_* constants if changed"

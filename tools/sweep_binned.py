@@ -4,7 +4,11 @@ Each config runs in its own SUBPROCESS with a timeout: a wedged remote
 compile (observed — it can hang the axon tunnel indefinitely) then costs
 one config, not the whole sweep.  Inside the child, module globals
 (SB/CH/SLOT/RB/CH2 + derived) are monkeypatched before plan build and run;
-the NumPy plan builder is used (the native one bakes the constants in).
+since round 4 the C++ builder takes the geometry as arguments, so plans
+build native (O(E)) at every config.
+
+SWEEP_SHAPE=products sweeps the sparse-graph presets at the ogbn-products
+shape instead (the north-star A/B's kernel-level companion).
 
 Results of record: docs/PERF.md (2026-07-31 sweep that picked SLOT=128).
 Run on hardware:  python tools/sweep_binned.py
@@ -25,6 +29,15 @@ H = int(os.environ.get("SWEEP_H", 256))
 E = int(os.environ.get("SWEEP_E", 23_526_267))
 N = int(os.environ.get("SWEEP_N", 232_965))
 CHILD_TIMEOUT_S = int(os.environ.get("SWEEP_TIMEOUT_S", 600))
+
+def _products_configs():
+    """The sparse presets (binned.py GEOM_*) at the production
+    group-row target — derived from the single source of truth so a
+    preset retune can't leave this sweep measuring stale tuples."""
+    import roc_tpu.ops.pallas.binned as B
+    return [tuple(g) + (B._GROUP_ROW_TARGET,)
+            for g in (B.GEOM_MID, B.GEOM_SPARSE, B.GEOM_XSPARSE)]
+
 
 # (SB, CH, SLOT, RB, CH2, group_row_target)
 CONFIGS = [
@@ -48,8 +61,6 @@ def run_one(sb, ch, slot, rb, ch2, grt):
     import roc_tpu.ops.pallas.binned as B
 
     B.SB, B.CH, B.SLOT, B.RB, B.CH2 = sb, ch, slot, rb, ch2
-    B.NSLOT = ch // slot
-    B.SLOT2 = ch2 // slot
 
     rng = np.random.default_rng(0)
     src = rng.integers(0, N, E).astype(np.int64)
@@ -57,7 +68,7 @@ def run_one(sb, ch, slot, rb, ch2, grt):
     x = jnp.asarray(rng.standard_normal((N, H), dtype=np.float32))
 
     t0 = time.time()
-    plan = B._build_binned_plan_numpy(src, dst, N, N, group_row_target=grt)
+    plan = B.build_binned_plan(src, dst, N, N, group_row_target=grt)
     tb = time.time() - t0
     G, C1 = plan.p1_blk.shape
     C2 = plan.p2_obi.shape[1]
@@ -80,7 +91,9 @@ def main():
     if len(sys.argv) == 7:                  # child mode
         run_one(*(int(a) for a in sys.argv[1:]))
         return
-    for cfg in CONFIGS:
+    configs = _products_configs() \
+        if os.environ.get("SWEEP_SHAPE") == "products" else CONFIGS
+    for cfg in configs:
         sb, ch, slot, rb, ch2, grt = cfg
         if ch2 % slot or ch % slot:
             print(f"{cfg}: skipped (SLOT must divide CH and CH2)")
