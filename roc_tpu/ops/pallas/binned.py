@@ -80,8 +80,9 @@ CH = 2048     # edge slots per phase-1 chunk
 SLOT = 128
 RB = 512      # destination rows per bin (phase-2 resident window)
 CH2 = 4096    # staging rows per phase-2 chunk
-NSLOT = CH // SLOT
-SLOT2 = CH2 // SLOT   # slots per phase-2 chunk
+# (nslot/slot2 derive on Geometry below — every consumer rebinds from the
+# plan's geometry, so no module-level derived constants exist to go stale
+# under tools/sweep_binned.py's monkeypatching of the five above)
 
 
 from typing import NamedTuple

@@ -539,6 +539,23 @@ def test_resolve_backend_uses_stats(monkeypatch):
     assert drv.resolve_backend("auto", e, n, n, src, dst) == "binned"
 
 
+def test_sweep_products_configs_match_presets():
+    """tools/sweep_binned.py hardcodes the preset tuples so its parent
+    process never imports jax (subprocess isolation); this pin fails if a
+    preset retune forgets that mirror."""
+    import importlib.util
+    import os as _os
+    from roc_tpu.ops.pallas import binned as B
+    spec = importlib.util.spec_from_file_location(
+        "sweep_binned", _os.path.join(_os.path.dirname(__file__), "..",
+                                      "tools", "sweep_binned.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    want = [tuple(g) + (B._GROUP_ROW_TARGET,)
+            for g in (B.GEOM_MID, B.GEOM_SPARSE, B.GEOM_XSPARSE)]
+    assert mod.CONFIGS_PRODUCTS == want, (mod.CONFIGS_PRODUCTS, want)
+
+
 def test_binned_fuzz_plan_and_run():
     """Property fuzz: random geometries through both plan builders and the
     interpret-mode kernels must match the oracle (and each other)."""

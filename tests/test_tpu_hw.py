@@ -170,6 +170,70 @@ def test_gat_plan_on_hw():
                                rtol=1e-2, atol=1e-2)
 
 
+def test_binned_sparse_geometries_on_hw():
+    """Round-4 geometry presets compiled on the chip — slot-16 staging
+    DMAs, the shrunken ch/ch2 chunks, and the 2048-row windows are new
+    Mosaic surface that interpret mode cannot vet (two interpret-only
+    escapes shipped before; docs/PERF.md).  Both precisions, incl. a
+    lane-unaligned H."""
+    from roc_tpu.ops.pallas.binned import (GEOM_MID, GEOM_SPARSE,
+                                           GEOM_XSPARSE, build_binned_plan,
+                                           run_binned)
+    rng = np.random.default_rng(7)
+    for geom in (GEOM_MID, GEOM_SPARSE, GEOM_XSPARSE):
+        for (n, t, e, h) in [(3 * geom.rb, 2 * geom.sb + 1, 60000, 128),
+                             (2000, 2000, 40000, 41)]:
+            src = rng.integers(0, t, e).astype(np.int64)
+            dst = rng.integers(0, n, e).astype(np.int64)
+            x = rng.standard_normal((t, h), dtype=np.float32)
+            plan = build_binned_plan(src, dst, n, t,
+                                     group_row_target=1 << 17, geom=geom)
+            msg = f"geom={tuple(geom)} n={n} t={t} h={h}"
+            out = np.asarray(run_binned(jnp.asarray(x), plan,
+                                        interpret=False))
+            np.testing.assert_allclose(out, _oracle_bf16(x, src, dst, n),
+                                       rtol=1e-4, atol=5e-2, err_msg=msg)
+            out_e = np.asarray(run_binned(jnp.asarray(x), plan,
+                                          interpret=False,
+                                          precision="exact"))
+            ref = np.zeros((n, h), np.float32)
+            np.add.at(ref, dst, x[src])
+            np.testing.assert_allclose(out_e, ref, rtol=2e-6, atol=1e-4,
+                                       err_msg=msg + " exact")
+
+
+def test_edge_gat_windowed_plans_on_hw():
+    """edge_gat_attend's building blocks on the chip: _plan_max/_plan_sum
+    over WINDOWED (base-shifted) plans — the per-block treatment the
+    edge-sharded attention runs inside shard_map.  Single-chip here (the
+    collectives are CPU-mesh-validated); this pins the compiled one-hot
+    window machinery at a nonzero base."""
+    from roc_tpu.ops import edge as em
+    from roc_tpu.ops.edge import GatPlans, _position_plan
+    rng = np.random.default_rng(11)
+    NS, Eb, K = 4096, 30000, 3
+    base = 1024                       # window base: rows [1024, 3072)
+    span = 2048
+    ed = np.sort(rng.integers(base, base + span, Eb).astype(np.int64))
+    es = rng.integers(0, NS, Eb).astype(np.int64)
+    s = rng.standard_normal((Eb, K), dtype=np.float32)
+    pos = np.arange(Eb, dtype=np.int64)
+    d = _position_plan(ed - base, pos, es, span)
+    plans = GatPlans(*(jnp.asarray(a) for a in d + d), num_rows=span,
+                     table_rows=span)
+    m = np.asarray(em._plan_max(jnp.asarray(s), plans.dst_obi,
+                                plans.dst_edst, plans.dst_pos, span))
+    mo = np.full((span, K), -np.inf, np.float32)
+    np.maximum.at(mo, ed - base, s)
+    np.testing.assert_allclose(m, mo, rtol=1e-5, atol=1e-5)
+    z = np.asarray(em._plan_sum(jnp.asarray(s), None, plans.dst_obi,
+                                plans.dst_edst, plans.dst_pos,
+                                plans.dst_nid, span, "highest"))
+    zo = np.zeros((span, K), np.float32)
+    np.add.at(zo, ed - base, s)
+    np.testing.assert_allclose(z, zo, rtol=1e-4, atol=1e-3)
+
+
 if __name__ == "__main__":   # direct hardware run, no pytest/conftest
     if not tpu:
         raise SystemExit("no TPU backend")
@@ -181,4 +245,6 @@ if __name__ == "__main__":   # direct hardware run, no pytest/conftest
     test_binned_no_pipeline_fallback_on_hw()
     test_binned_exact_on_hw()
     test_gat_plan_on_hw()
+    test_binned_sparse_geometries_on_hw()
+    test_edge_gat_windowed_plans_on_hw()
     print("tpu hardware tests: all ok")

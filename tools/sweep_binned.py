@@ -30,14 +30,17 @@ E = int(os.environ.get("SWEEP_E", 23_526_267))
 N = int(os.environ.get("SWEEP_N", 232_965))
 CHILD_TIMEOUT_S = int(os.environ.get("SWEEP_TIMEOUT_S", 600))
 
-def _products_configs():
-    """The sparse presets (binned.py GEOM_*) at the production
-    group-row target — derived from the single source of truth so a
-    preset retune can't leave this sweep measuring stale tuples."""
-    import roc_tpu.ops.pallas.binned as B
-    return [tuple(g) + (B._GROUP_ROW_TARGET,)
-            for g in (B.GEOM_MID, B.GEOM_SPARSE, B.GEOM_XSPARSE)]
-
+# The sparse presets (binned.py GEOM_*) at the production group-row
+# target.  Hardcoded so the sweep PARENT never imports jax/roc_tpu (the
+# subprocess-isolation design: only children may touch anything that can
+# wedge); tests/test_binned.py::test_sweep_products_configs_match_presets
+# pins these against the Geometry literals, so a preset retune that
+# forgets this mirror fails CI instead of measuring stale tuples.
+CONFIGS_PRODUCTS = [
+    (512, 2048, 32, 512, 4096, 1 << 21),     # GEOM_MID
+    (1024, 2048, 16, 1024, 2048, 1 << 21),   # GEOM_SPARSE
+    (2048, 1024, 16, 2048, 1024, 1 << 21),   # GEOM_XSPARSE
+]
 
 # (SB, CH, SLOT, RB, CH2, group_row_target)
 CONFIGS = [
@@ -91,7 +94,7 @@ def main():
     if len(sys.argv) == 7:                  # child mode
         run_one(*(int(a) for a in sys.argv[1:]))
         return
-    configs = _products_configs() \
+    configs = CONFIGS_PRODUCTS \
         if os.environ.get("SWEEP_SHAPE") == "products" else CONFIGS
     for cfg in configs:
         sb, ch, slot, rb, ch2, grt = cfg
