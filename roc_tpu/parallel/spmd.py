@@ -112,6 +112,21 @@ jax.tree_util.register_dataclass(
     meta_fields=["span_fwd", "span_bwd"])
 
 
+def _block_window(keys, NS: int, allgather=None):
+    """(base [L], span): each block's VB-aligned window over its key
+    range, span raised to the (optionally allgathered) maximum and
+    clamped so base + span <= NS — the accumulator has exactly NS rows,
+    and dynamic_update_slice would otherwise clamp the start and shift a
+    block's values onto wrong rows.  Relative ids still fit: keys.max
+    <= NS - 1 <= base + span - 1."""
+    from roc_tpu.ops.pallas.segment_sum import VB
+    base = (keys.min(axis=1) // VB) * VB
+    span = int((keys.max(axis=1) + 1 - base).max())
+    span = min(-(-_allgather_floors([[span]], allgather)[0] // VB) * VB,
+               NS)
+    return np.minimum(base, NS - span), span
+
+
 def _windowed_block_plans(gather, scatter, NS: int, allgather=None):
     """Per-block chunk plans over each block's contiguous scatter window.
 
@@ -120,19 +135,10 @@ def _windowed_block_plans(gather, scatter, NS: int, allgather=None):
     edst, esrc stacked [L, C(, EB)], base [L], span).  ``allgather``
     raises the static shapes (span, chunk count C) to the global maxima —
     the -perhost contract of shard_load.allgather_floors."""
-    from roc_tpu.ops.pallas.segment_sum import VB, build_chunk_plan, \
-        pad_chunks
+    from roc_tpu.ops.pallas.segment_sum import build_chunk_plan, pad_chunks
 
     L_ = scatter.shape[0]
-    bases = (scatter.min(axis=1) // VB) * VB
-    span = int((scatter.max(axis=1) + 1 - bases).max())
-    span = min(-(-_allgather_floors([[span]], allgather)[0] // VB) * VB,
-               NS)
-    # The accumulator has exactly NS rows, so base + span <= NS must hold
-    # (dynamic_update_slice would otherwise clamp the start and shift the
-    # block's sums onto wrong rows).  Relative ids still fit: scatter.max
-    # <= NS - 1 <= base + span - 1.
-    bases = np.minimum(bases, NS - span)
+    bases, span = _block_window(scatter, NS, allgather)
     plans = [build_chunk_plan(
         np.asarray(gather[p], np.int32),
         np.asarray(scatter[p] - bases[p], np.int32), span)
@@ -179,12 +185,8 @@ def _edge_mm_half(x, obi, edst, esrc, base, span: int, precision):
     block's window base in the global accumulator, reduce onto owners."""
     from roc_tpu.ops.aggregate import _matmul_run
     table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)    # [P*S, H]
-    NS, H = table.shape
     part_loc = _matmul_run(table, obi, edst, esrc, span, precision)
-    acc = jnp.zeros((NS, H), part_loc.dtype) + 0 * part_loc[:1, :1]
-    acc = jax.lax.dynamic_update_slice(acc, part_loc, (base, 0))
-    return jax.lax.psum_scatter(acc, PARTS_AXIS, scatter_dimension=0,
-                                tiled=True)
+    return _scatter_to_owner(part_loc, base, table.shape[0])
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -242,15 +244,10 @@ def build_edge_binned_plans(graph, meta, fwd_arrays=None):
         else edge_block_arrays(graph, meta)
     b_gat, b_sct = edge_block_arrays_t(graph, meta)
     P_, Eb = f_sct.shape
-    from roc_tpu.ops.pallas.segment_sum import VB
-
     from roc_tpu.ops.pallas.binned import build_binned_plan
 
     def direction(gather, scatter):
-        bases = (scatter.min(axis=1) // VB) * VB
-        span = int((scatter.max(axis=1) + 1 - bases).max())
-        span = min(-(-span // VB) * VB, NS)
-        bases = np.minimum(bases, NS - span)
+        bases, span = _block_window(scatter, NS)
         if not binned_viable(span, NS, Eb):
             return None
         return [build_binned_plan(
@@ -278,12 +275,8 @@ def _eb_half(x, plan, base, interpret, precision):
     block's base, reduce onto owners (same shape as _edge_mm_half)."""
     from roc_tpu.ops.pallas.binned import run_binned
     table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)    # [NS, H]
-    NS, H = table.shape
     part_loc = run_binned(table, plan, interpret, precision)  # [span, H]
-    acc = jnp.zeros((NS, H), part_loc.dtype) + 0 * part_loc[:1, :1]
-    acc = jax.lax.dynamic_update_slice(acc, part_loc, (base, 0))
-    return jax.lax.psum_scatter(acc, PARTS_AXIS, scatter_dimension=0,
-                                tiled=True)
+    return _scatter_to_owner(part_loc, base, table.shape[0])
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -356,26 +349,18 @@ def build_edge_gat_plans_arrays(meta, es, ed,
     arrays; ``allgather`` raises window spans and chunk counts to the
     global maxima (the -perhost static-shape contract)."""
     from roc_tpu.ops.edge import GatPlans, _position_plan, pad_gat_plans
-    from roc_tpu.ops.pallas.segment_sum import VB
     NS = meta.num_parts * meta.shard_nodes
     es = np.asarray(es, np.int64)
     ed = np.asarray(ed, np.int64)
     L_, Eb = es.shape
 
-    def window(keys):
-        base = (keys.min(axis=1) // VB) * VB
-        span = int((keys.max(axis=1) + 1 - base).max())
-        span = min(-(-_allgather_floors([[span]], allgather)[0] // VB)
-                   * VB, NS)
-        return np.minimum(base, NS - span), span
-
-    dbase, span_d = window(ed)
+    dbase, span_d = _block_window(ed, NS, allgather)
     orders = np.argsort(es, axis=1, kind="stable")
     es_sorted = np.take_along_axis(es, orders, axis=1)
-    sbase, span_s = window(es_sorted)
+    sbase, span_s = _block_window(es_sorted, NS, allgather)
     plans = []
+    pos = np.arange(Eb, dtype=np.int64)
     for p in range(L_):
-        pos = np.arange(Eb, dtype=np.int64)
         d = _position_plan(ed[p] - dbase[p], pos, es[p], span_d)
         s = _position_plan(es_sorted[p] - sbase[p], orders[p], ed[p],
                            span_s)
