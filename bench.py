@@ -82,9 +82,18 @@ BACKEND = os.environ.get("ROC_BENCH_BACKEND", "auto")
 # one-hot dots; golden-curve-validated, docs/GOLDEN.md).  Overriding to
 # exact annotates the metric name so histories are never conflated.
 PRECISION = os.environ.get("ROC_BENCH_PRECISION", "fast")
-# ROC_BENCH_REORDER=1: RCM locality pass before training (graph/reorder.py)
-# — annotates the metric; the canonical number stays unreordered.
-REORDER = _env("ROC_BENCH_REORDER", "0", int) != 0
+# ROC_BENCH_REORDER=1|auto: RCM locality pass before training
+# (graph/reorder.py; "auto" keeps the order only on a measured >=10%
+# padded-row reduction) — annotates the metric; canonical stays off.
+_REORDER_RAW = os.environ.get("ROC_BENCH_REORDER", "0")
+REORDER = {"0": "off", "": "off", "1": "on"}.get(_REORDER_RAW,
+                                                 _REORDER_RAW)
+if REORDER not in ("off", "on", "auto"):
+    # fail BEFORE the (minutes-long at products shape) graph build, and
+    # before the bogus value bakes into METRIC
+    print(f"# ignoring malformed ROC_BENCH_REORDER={_REORDER_RAW!r} "
+          f"(want 0|1|auto)", file=sys.stderr)
+    REORDER = "off"
 # ROC_BENCH_INTER=ring: inter-community edges go to ring-adjacent
 # communities (hierarchical locality, the structure real co-purchase
 # graphs have) instead of uniformly — the case a locality reorder can
@@ -103,7 +112,7 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + "_epoch_time"
           + ("" if SCALE == 1.0 else f"_scale{SCALE:g}")
           + ("" if PRECISION == "fast" else f"_{PRECISION}")
-          + ("_reorder" if REORDER else "")
+          + ("" if REORDER == "off" else f"_reorder-{REORDER}")
           + ("" if INTER == "uniform" else f"_inter-{INTER}"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
@@ -324,12 +333,11 @@ def run():
     print(f"# graph ready: {ds.graph.num_nodes} nodes "
           f"{ds.graph.num_edges} edges ({time.time()-t0:.1f}s)",
           file=sys.stderr)
-    if REORDER:
-        from roc_tpu.graph.reorder import reorder_dataset
+    if REORDER != "off":
+        from roc_tpu.graph.reorder import maybe_reorder_dataset
         t0 = time.time()
-        ds, _ = reorder_dataset(ds)
-        print(f"# RCM locality reorder applied ({time.time()-t0:.1f}s)",
-              file=sys.stderr)
+        ds, _, note = maybe_reorder_dataset(ds, REORDER)
+        print(f"# {note} ({time.time()-t0:.1f}s)", file=sys.stderr)
 
     def build_and_warm(backend):
         cfg = Config(layers=LAYERS, num_epochs=1, learning_rate=0.01,
@@ -418,7 +426,7 @@ def run():
         result["fallback"] = f"auto failed ({fallback_from}); ran {fb}"
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
-            and CANONICAL_SHAPE and not REORDER
+            and CANONICAL_SHAPE and REORDER == "off"
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

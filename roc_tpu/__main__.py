@@ -73,17 +73,19 @@ def main(argv=None) -> int:
         print("error: one of -file or -dataset is required", file=sys.stderr)
         return 2
 
-    if cfg.reorder:
+    if cfg.reorder not in (False, None, "off"):
         import time as _time
 
-        from roc_tpu.graph.reorder import reorder_dataset
-        assert not cfg.perhost_load, \
-            "-reorder needs the whole graph in memory; incompatible with " \
-            "-perhost (preprocess the dataset offline instead)"
+        from roc_tpu.graph.reorder import maybe_reorder_dataset
+        if cfg.perhost_load:
+            print("error: -reorder needs the whole graph in memory; "
+                  "incompatible with -perhost (preprocess the dataset "
+                  "offline instead)", file=sys.stderr)
+            return 2
         t0 = _time.time()
-        ds, _ = reorder_dataset(ds)
-        print(f"# RCM locality reorder: {ds.graph.num_nodes} nodes in "
-              f"{_time.time() - t0:.1f}s", file=sys.stderr)
+        ds, _, note = maybe_reorder_dataset(ds, cfg.reorder)
+        print(f"# {note} ({ds.graph.num_nodes} nodes, "
+              f"{_time.time() - t0:.1f}s)", file=sys.stderr)
 
     model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
                         heads=cfg.heads)

@@ -26,6 +26,17 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _vary_like(init, ref):
+    """Promote a scan-carry init to ``ref``'s device-varying vma annotation
+    WITHOUT a gradient edge: the `+ 0 * ref` spelling creates one, through
+    which a non-finite cotangent transposes to 0 * inf = NaN and a
+    non-finite ref element broadcasts NaN into the whole carry primal —
+    the _ring_attend bug class (spmd.py pcast note).  Axis-agnostic
+    (reads ref's vma), so it is a no-op outside shard_map."""
+    import jax as _jax
+    return _jax.lax.pcast(init, tuple(_jax.typeof(ref).vma), to="varying")
+
+
 # Above this many elements in the gathered [E, H] intermediate, sum
 # aggregation switches to an edge-chunked scan with in-place accumulation
 # (bounded memory).  2^28 elems = 1 GiB fp32.
@@ -51,7 +62,11 @@ def _chunked_segment_sum(x, edge_src, edge_dst, num_nodes: int):
     pad = nchunks * chunk - E
     src = jnp.pad(edge_src, (0, pad))                      # row 0: harmless
     dst = jnp.pad(edge_dst, (0, pad), constant_values=num_nodes)
-    acc = jnp.zeros((num_nodes + 1, H), x.dtype)
+    # The scan carry must be device-varying like x under shard_map's vma
+    # tracking; without the promotion the chunked path crashes the moment
+    # a SHARD's E*H crosses the threshold — caught at products shape with
+    # H=32, just past the bound the round-3 test grazed under.
+    acc = _vary_like(jnp.zeros((num_nodes + 1, H), x.dtype), x)
 
     def body(acc, sl):
         s, d = sl
@@ -253,12 +268,9 @@ def _matmul_run(x, obi, edst, esrc, num_rows: int, precision):
         return jax.lax.dynamic_update_slice(acc, cur + outs, (base, 0)), None
 
     # Accumulate across steps in fp32 even for bf16 activations (the Pallas
-    # path does the same via x.astype(fp32); the reference sums in fp32).
-    # `+ 0 * x[:1, :1]`: under shard_map's vma tracking the carry must be
-    # device-varying like x; this inherits the annotation without naming the
-    # mesh axis here.
-    acc = jnp.zeros((acc_rows, H), jnp.float32) + 0 * x[:1, :1].astype(
-        jnp.float32)
+    # path does the same via x.astype(fp32); the reference sums in fp32);
+    # carry promoted to x's device-varying annotation, axis-agnostically.
+    acc = _vary_like(jnp.zeros((acc_rows, H), jnp.float32), x)
     acc, _ = jax.lax.scan(
         body, acc, (obi.reshape(nsteps, cb), esrc.reshape(nsteps, cb, EB),
                     edst.reshape(nsteps, cb, EB)))

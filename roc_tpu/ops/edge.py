@@ -23,6 +23,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Normalizer guard for every softmax division (live rows have z >= 1 by
+# the max shift; the guard only touches edgeless/pad rows, whose quotient
+# is 0 either way).  The VALUE is load-bearing twice over:
+#   * >= ~1e-20, because XLA flushes subnormals to zero (a 1e-38 guard
+#     vanishes and edgeless rows hit 0/0 NaN);
+#   * >= ~1e-15, because the AUTODIFF transpose of a/b squares the
+#     denominator: 1/(1e-20)^2 = 1e40 overflows fp32 to inf and
+#     0 * inf = NaN silently poisons every parameter gradient (found at
+#     products shape via the chunked-GAT backward; the hand-derived
+#     custom-vjp backwards only ever divide by the first power, but the
+#     autodiff'd sites — chunked GAT, edge_softmax, ring/edge attention —
+#     go through d(a/b)/db = -a*ct/b^2).
+_Z_GUARD = 1e-15
+
 
 def edge_softmax(scores, edge_dst, num_nodes: int):
     """Per-destination softmax over in-edges.
@@ -37,9 +51,10 @@ def edge_softmax(scores, edge_dst, num_nodes: int):
     e = jnp.exp(scores - jnp.take(m, edge_dst, axis=0))
     s = jax.ops.segment_sum(e, edge_dst, num_segments=num_nodes,
                             indices_are_sorted=True)
-    # 1e-20, not 1e-38: subnormal guards flush to zero under XLA (see the
-    # chunked path below); live destinations have s >= 1 by the max shift.
-    return e / jnp.maximum(jnp.take(s, edge_dst, axis=0), 1e-20)
+    # _Z_GUARD (rationale at its definition above): survives the XLA
+    # subnormal flush AND the autodiff division transpose's square; live
+    # destinations have s >= 1 by the max shift.
+    return e / jnp.maximum(jnp.take(s, edge_dst, axis=0), _Z_GUARD)
 
 
 # GAT switches to the edge-chunked scan above the same gathered-intermediate
@@ -122,9 +137,16 @@ def _chunked_gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
         return m.at[d_ids].max(scores(s_ids, d_ids),
                                indices_are_sorted=True,
                                mode="promise_in_bounds"), None
-    m0 = jnp.full((num_nodes + 1, K), -jnp.inf, as_t.dtype)
+    # Scan carries must inherit the device-varying vma annotation under
+    # shard_map — via aggregate._vary_like (pcast: no gradient edge), NOT
+    # `+ 0 * x`.  The sentinel must also be FINITE (-1e30, not -inf):
+    # non-finite carry primals let the sharded backward manufacture
+    # 0 * inf NaNs — the _ring_attend trap.
+    from roc_tpu.ops.aggregate import _vary_like
+    NEG = jnp.asarray(-1e30, as_t.dtype)
+    m0 = _vary_like(jnp.full((num_nodes + 1, K), NEG, as_t.dtype), as_t)
     m, _ = jax.lax.scan(max_body, m0, (src, dst))
-    m = jnp.where(jnp.isfinite(m), m, 0.0)            # edgeless destinations
+    m = jnp.where(m > NEG * 0.5, m, 0.0)              # edgeless destinations
     m = jax.lax.stop_gradient(m)
 
     def acc_body(carry, sl):
@@ -138,14 +160,15 @@ def _chunked_gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
         out = out.at[d_ids].add(g * e[:, :, None], indices_are_sorted=True,
                                 mode="promise_in_bounds")
         return (z, out), None
-    z0 = jnp.zeros((num_nodes + 1, K), as_t.dtype)
-    o0 = jnp.zeros((num_nodes + 1, K, F), h.dtype)
+    z0 = _vary_like(jnp.zeros((num_nodes + 1, K), as_t.dtype), as_t)
+    o0 = _vary_like(jnp.zeros((num_nodes + 1, K, F), h.dtype), h)
     (z, out), _ = jax.lax.scan(
         jax.checkpoint(acc_body, prevent_cse=False), (z0, o0), (src, dst))
-    # 1e-20, not 1e-38: subnormal guards flush to zero under XLA and rows
-    # with no in-edges would hit 0/0 (live rows have z >= 1 by the max shift)
+    # _Z_GUARD (rationale at its definition above): edgeless rows would
+    # otherwise hit 0/0 in fwd or 0 * inf in the division transpose (live
+    # rows have z >= 1 by the max shift)
     return (out[:num_nodes]
-            / jnp.maximum(z[:num_nodes], 1e-20)[:, :, None])
+            / jnp.maximum(z[:num_nodes], _Z_GUARD)[:, :, None])
 
 
 # ---------------------------------------------------------------------------
@@ -324,9 +347,9 @@ def _plan_sum(edge_w, node_x, obi, edst, pos, nid, num_rows: int, precision):
         cur = jax.lax.dynamic_slice(acc, (base, 0), (cb * VB, H))
         return jax.lax.dynamic_update_slice(acc, cur + outs, (base, 0)), None
 
+    from roc_tpu.ops.aggregate import _vary_like
     ref = edge_w if edge_w is not None else node_x
-    acc = jnp.zeros((acc_rows, H), jnp.float32) \
-        + 0 * ref.reshape(-1)[0].astype(jnp.float32)
+    acc = _vary_like(jnp.zeros((acc_rows, H), jnp.float32), ref)
     acc, _ = jax.lax.scan(
         body, acc, (obi.reshape(nsteps, cb), edst.reshape(nsteps, cb, EB),
                     pos.reshape(nsteps, cb, EB), nid.reshape(nsteps, cb, EB)))
@@ -366,7 +389,8 @@ def _plan_max(edge_w, obi, edst, pos, num_rows: int):
         return jax.lax.dynamic_update_slice(
             acc, jnp.maximum(cur, outs), (ob[0], 0, 0)), None
 
-    acc = jnp.full((acc_rows // VB, VB, K), neg) + 0 * edge_w.reshape(-1)[0]
+    from roc_tpu.ops.aggregate import _vary_like
+    acc = _vary_like(jnp.full((acc_rows // VB, VB, K), neg), edge_w)
     acc, _ = jax.lax.scan(
         body, acc, (obi.reshape(nsteps, cb), edst.reshape(nsteps, cb, EB),
                     pos.reshape(nsteps, cb, EB)))
@@ -432,10 +456,11 @@ def _gat_plan_fwd(h, table, a_src, a_dst, plans, edge_ids, slope,
                   plans.dst_nid, N, "highest")            # [N, K]
     u = _plan_sum(e, table, plans.dst_obi, plans.dst_edst, plans.dst_pos,
                   plans.dst_nid, N, precision)            # [N, K, F]
-    # Guard must be a NORMAL float: XLA flushes subnormals (1e-38) to zero,
+    # Guard is _Z_GUARD (rationale at its definition): XLA flushes
+    # subnormals to zero,
     # and rows with no in-edges (padded shard rows) have z == 0 → 0/0 NaN.
     # Any live row has z >= 1 (the max edge contributes exp(0)).
-    zc = jnp.maximum(z, 1e-20)
+    zc = jnp.maximum(z, _Z_GUARD)
     out = u / zc[:, :, None]
     return out, (h, table, a_src, a_dst, plans, edge_ids,
                  q >= 0, e, zc, out)

@@ -435,9 +435,10 @@ def _egat_fwd(h, a_src, a_dst, egp, edge_ids, slope, precision):
     z = _scatter_to_owner(z_loc, egp.dst_base, NS)               # [S, K]
     u = _scatter_to_owner(u_loc.reshape(span_d, K * F),
                           egp.dst_base, NS).reshape(S, K, F)
-    # 1e-20, not 1e-38: subnormals flush to zero under XLA (0/0 on
+    # _Z_GUARD (ops/edge.py): big enough to survive BOTH the XLA
+    # subnormal flush AND the autodiff division transpose (0/0 on
     # edgeless rows); live rows have z >= 1 by the max shift
-    zc = jnp.maximum(z, 1e-20)
+    zc = jnp.maximum(z, _Z_GUARD)
     out = u / zc[:, :, None]
     return out, (h, table, a_src, a_dst, egp, edge_ids, q >= 0, e, zc, out)
 
@@ -542,6 +543,7 @@ def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
 # Canonical home is graph.shard_load (the allgather utilities layer);
 # re-exported here for the in-module call sites and backward compat.
 from roc_tpu.graph.shard_load import allgather_floors as _allgather_floors  # noqa: E402,E501
+from roc_tpu.ops.edge import _Z_GUARD  # noqa: E402  (guard rationale there)
 
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
@@ -716,9 +718,10 @@ def _edge_attend(gd_block, h, a_src, a_dst, slope: float):
     u = jax.lax.psum_scatter(u_part.reshape(NS, K * F), PARTS_AXIS,
                              scatter_dimension=0,
                              tiled=True).reshape(S, K, F)
-    # 1e-20, not 1e-38: subnormals flush to zero under XLA (0/0 on
+    # _Z_GUARD (ops/edge.py): big enough to survive BOTH the XLA
+    # subnormal flush AND the autodiff division transpose (0/0 on
     # edgeless rows); live rows have z >= 1 by the max shift
-    return u / jnp.maximum(z, 1e-20)[:, :, None]
+    return u / jnp.maximum(z, _Z_GUARD)[:, :, None]
 
 
 def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
@@ -795,9 +798,10 @@ def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
     (_, _, z, u), _ = jax.lax.scan(
         jax.checkpoint(step, prevent_cse=False), (h, m0, z0, u0),
         jnp.arange(P_))
-    # 1e-20, not 1e-38: subnormals flush to zero under XLA (0/0 on
+    # _Z_GUARD (ops/edge.py): big enough to survive BOTH the XLA
+    # subnormal flush AND the autodiff division transpose (0/0 on
     # edgeless rows); live rows have z >= 1 by the max shift
-    return u / jnp.maximum(z, 1e-20)[:, :, None]
+    return u / jnp.maximum(z, _Z_GUARD)[:, :, None]
 
 
 def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:

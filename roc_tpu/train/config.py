@@ -74,10 +74,13 @@ class Config:
                                   # padded-max tax exceeds ~30% (docs/PERF.md
                                   # rule of thumb); True/"on", False/"off"
                                   # force it
-    reorder: bool = False         # RCM locality pass before partitioning
+    reorder: object = "off"       # RCM locality pass before partitioning
                                   # (graph/reorder.py — concentrates the
-                                  # (block, bin) cells the TPU tiled kernels
-                                  # pay for; no reference counterpart)
+                                  # (block, bin) cells the TPU tiled
+                                  # kernels pay for; no reference
+                                  # counterpart).  "off" | "on"/True |
+                                  # "auto" (keep only on a measured >=10%
+                                  # padded-row reduction)
 
     def exchange_mode(self) -> str:
         """Effective exchange mode ('halo' | 'allgather' | 'ring')."""
@@ -126,7 +129,8 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-perhost", dest="perhost_load", action="store_true")
     p.add_argument("-edge-shard", dest="edge_shard", nargs="?", const="on",
                    default="auto", choices=["on", "off", "auto"])
-    p.add_argument("-reorder", action="store_true")
+    p.add_argument("-reorder", nargs="?", const="on", default="off",
+                   choices=["on", "off", "auto"])
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

@@ -214,6 +214,45 @@ def test_overcommit_parts_per_device_match_single():
         np.testing.assert_allclose(l16, l1, rtol=1e-4, err_msg=f"epoch {i}")
 
 
+def test_chunked_paths_inside_shard_map(monkeypatch):
+    """Regression (found at products shape, H=32): the memory-bounded
+    chunked scan paths — _chunked_segment_sum and _chunked_gat_attend —
+    must carry device-varying vma through their scans, or the sharded xla
+    backend crashes the moment a SHARD's E*H crosses the chunk threshold
+    (the round-3 products rehearsal happened to sit just under it).
+    Thresholds are shrunk so the chunked paths run at test scale; losses
+    must match the unchunked run."""
+    import roc_tpu.ops.aggregate as agg
+    import roc_tpu.ops.edge as em
+    from roc_tpu.models import build_gat, build_gcn
+
+    ds = datasets.synthetic("chunked-vma", 400, 6.0, 10, 4, n_train=80,
+                            n_val=80, n_test=80, seed=17)
+    base = dict(layers=[10, 8, 4], num_epochs=2, dropout_rate=0.0,
+                eval_every=10**9, num_parts=4, halo=True,
+                aggregate_backend="xla", edge_shard="off")
+
+    ref = SpmdTrainer(Config(**base), ds, build_gcn(base["layers"], 0.0))
+    losses = [float(ref.run_epoch()) for _ in range(2)]
+
+    monkeypatch.setattr(agg, "_CHUNK_THRESHOLD_ELEMS", 1 << 10)
+    tr = SpmdTrainer(Config(**base), ds, build_gcn(base["layers"], 0.0))
+    for i in range(2):
+        np.testing.assert_allclose(float(tr.run_epoch()), losses[i],
+                                   rtol=1e-5, err_msg=f"gcn epoch {i}")
+
+    refg = SpmdTrainer(Config(**base, model="gat"), ds,
+                       build_gat(base["layers"], 0.0, heads=2))
+    gl = [float(refg.run_epoch()) for _ in range(2)]
+    monkeypatch.setattr(em, "_GAT_CHUNK_THRESHOLD_ELEMS", 1 << 10)
+    monkeypatch.setattr(em, "_GAT_CHUNK_MIN", 64)
+    trg = SpmdTrainer(Config(**base, model="gat"), ds,
+                      build_gat(base["layers"], 0.0, heads=2))
+    for i in range(2):
+        np.testing.assert_allclose(float(trg.run_epoch()), gl[i],
+                                   rtol=1e-4, err_msg=f"gat epoch {i}")
+
+
 @pytest.mark.slow
 def test_overcommit_gat_and_plan_backend():
     """Overcommit composes with the matmul plan backend and with GAT

@@ -102,6 +102,40 @@ def test_native_rcm_equals_numpy():
                                   rcm_order(g, use_native=False))
 
 
+def test_maybe_reorder_auto_keeps_only_on_gain():
+    """-reorder auto: kept on an id-shuffled community graph (big measured
+    padded-row reduction), skipped on a uniform graph (no gain) — the
+    stats decide, not a guess."""
+    from roc_tpu.graph.datasets import Dataset
+    from roc_tpu.graph.reorder import maybe_reorder_dataset
+    rng = np.random.default_rng(8)
+
+    def wrap(g):
+        return Dataset(name="m", graph=g,
+                       features=rng.normal(size=(g.num_nodes, 4)).astype(
+                           np.float32),
+                       labels=None,
+                       label_ids=np.zeros(g.num_nodes, np.int64),
+                       mask=np.zeros(g.num_nodes, np.int32),
+                       in_dim=4, num_classes=2)
+
+    comm = wrap(_community_graph(32768, 256, 150_000, rng, shuffle=True))
+    ds2, applied, note = maybe_reorder_dataset(comm, "auto")
+    assert applied and "kept" in note, note
+    assert ds2.graph is not comm.graph
+
+    from roc_tpu.graph.csr import add_self_edges, from_edges
+    uni = wrap(add_self_edges(from_edges(
+        4096, rng.integers(0, 4096, 20_000),
+        rng.integers(0, 4096, 20_000))))
+    ds3, applied, note = maybe_reorder_dataset(uni, "auto")
+    assert not applied and "skipped" in note, note
+    assert ds3 is uni
+    # off: untouched, no order computed
+    ds4, applied, _ = maybe_reorder_dataset(uni, "off")
+    assert ds4 is uni and not applied
+
+
 def test_reorder_dataset_trains_isomorphically():
     """Same losses (up to fp32 reassociation) with and without the reorder:
     features/labels/masks move with their vertices."""

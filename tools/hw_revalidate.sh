@@ -53,14 +53,15 @@ for be in matmul auto; do
     $PROD ROC_BENCH_BACKEND=$be timeout 3000 python bench.py 2>&1 \
         | tail -2 | tee -a "$LOG"
 done
-# with the RCM locality pass: choose_geometry should then pick a binned
-# geometry (graph/reorder.py) — the candidate winner for the north star
-$PROD ROC_BENCH_BACKEND=auto ROC_BENCH_REORDER=1 timeout 3000 \
+# with the RCM locality pass (auto keeps the order only on a measured
+# padded-row gain): choose_geometry should then pick a binned geometry
+# (graph/reorder.py) — the candidate winner for the north star
+$PROD ROC_BENCH_BACKEND=auto ROC_BENCH_REORDER=auto timeout 3000 \
     python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 # hierarchical-locality variant (inter edges ring-adjacent, the structure
 # real co-purchase graphs have): A/B the reorder win where it can exist —
 # the uniform-inter runs above are the locality worst case
-for rr in 0 1; do
+for rr in 0 auto; do
     $PROD ROC_BENCH_BACKEND=auto ROC_BENCH_INTER=ring ROC_BENCH_REORDER=$rr \
         timeout 3000 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 done
