@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from roc_tpu import ops
-from roc_tpu.ops.pallas.binned import RB, SB, SLOT, build_binned_plan, run_binned
+from roc_tpu.ops.pallas.binned import RB, SB, build_binned_plan, run_binned
 
 
 def oracle_bf16(x, src, dst, n):

@@ -14,7 +14,6 @@ from roc_tpu.models import build_gcn, build_sage
 from roc_tpu.parallel.check import check_shard_consistency
 from roc_tpu.parallel.spmd import SpmdTrainer
 from roc_tpu.train.config import Config
-from roc_tpu.train.driver import Trainer
 
 
 def small_ds(seed=5):

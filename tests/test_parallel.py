@@ -7,7 +7,6 @@ replication, v1 halo all_to_all) are tested against it and each other.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
