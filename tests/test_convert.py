@@ -164,6 +164,38 @@ def test_lesmis_is_the_real_graph():
     assert int((ds.mask == lux.MASK_TRAIN).sum()) == 10   # 2 per class
 
 
+def test_convert_rocfile_reorder_roundtrip(tmp_path):
+    """tools/convert.py rocfile --reorder: re-processing an on-disk
+    dataset through the RCM pass (the preprocess-once workflow) must
+    yield an ISOMORPHIC dataset — same losses, features/labels/mask
+    moved with their vertices — plus the transpose sidecar."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import importlib
+    cvt = importlib.import_module("convert")
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    assert cvt.main(["lesmis", "-o", a]) == 0
+    assert cvt.main(["rocfile", "--file", a, "--in-dim", "77",
+                     "--classes", "5", "-o", b, "--reorder",
+                     "--with-transpose"]) == 0
+    assert os.path.exists(b + lux.TLUX_SUFFIX)
+    da = datasets.load_roc_dataset(a, 77, 5)
+    db = datasets.load_roc_dataset(b, 77, 5)
+    assert da.graph.num_edges == db.graph.num_edges
+    assert int((da.mask == lux.MASK_TRAIN).sum()) == \
+        int((db.mask == lux.MASK_TRAIN).sum())
+    cfg = Config(layers=[77, 8, 5], num_epochs=2, dropout_rate=0.0,
+                 eval_every=10**9, seed=1)
+    ta = Trainer(cfg, da, build_model("gcn", cfg.layers, 0.0, "sum"))
+    tb = Trainer(cfg, db, build_model("gcn", cfg.layers, 0.0, "sum"))
+    for i in range(2):
+        la, lb = float(ta.run_epoch()), float(tb.run_epoch())
+        np.testing.assert_allclose(lb, la, rtol=2e-4, err_msg=f"epoch {i}")
+
+
 @pytest.mark.slow
 def test_golden_davis_curve():
     """Real-data golden curve on a BIPARTITE graph (docs/GOLDEN.md):

@@ -74,6 +74,13 @@ def main(argv=None) -> int:
     sub.add_parser("lesmis", help="vendored real graph: Les Misérables "
                                   "co-occurrences (Knuth 1993)")
 
+    r = sub.add_parser("rocfile", help="re-process an existing ROC-format "
+                                      "dataset (e.g. to apply --reorder "
+                                      "or add the transpose sidecar)")
+    r.add_argument("--file", required=True, help="input path prefix")
+    r.add_argument("--in-dim", type=int, required=True)
+    r.add_argument("--classes", type=int, required=True)
+
     for s in sub.choices.values():
         s.add_argument("-o", "--out", required=True,
                        help="output path prefix")
@@ -81,6 +88,13 @@ def main(argv=None) -> int:
                        help="also write the transposed-graph sidecar "
                             "(.t.lux) that -edge-shard -perhost loading "
                             "needs for its backward blocks")
+        s.add_argument("--reorder", nargs="?", const="on", default="off",
+                       choices=["on", "off", "auto"],
+                       help="apply the RCM locality pass before writing "
+                            "(graph/reorder.py; 'auto' keeps the order "
+                            "only on a measured >=10%% cell-padding "
+                            "reduction) — preprocess once instead of "
+                            "paying -reorder per run")
 
     a = p.parse_args(argv)
     if a.cmd == "edgelist":
@@ -102,12 +116,19 @@ def main(argv=None) -> int:
                               feats_path=a.feats, undirected=a.undirected,
                               self_edges=not a.no_self_edges, split=split,
                               seed=a.seed)
+    elif a.cmd == "rocfile":
+        from roc_tpu.graph import datasets as _ds
+        ds = _ds.load_roc_dataset(a.file, a.in_dim, a.classes)
     elif a.cmd == "davis":
         ds = convert.davis_women()
     elif a.cmd == "lesmis":
         ds = convert.les_miserables()
     else:
         ds = convert.karate_club()
+    if a.reorder != "off":
+        from roc_tpu.graph.reorder import maybe_reorder_dataset
+        ds, _, note = maybe_reorder_dataset(ds, a.reorder)
+        print(f"# {note}", file=sys.stderr)
     convert.write(ds, a.out)
     if a.with_transpose:
         from roc_tpu.graph import lux
