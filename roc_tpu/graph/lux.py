@@ -225,6 +225,11 @@ def write_dataset(prefix: str, g: Csr, feats: np.ndarray, label_ids: np.ndarray,
         os.makedirs(parent, exist_ok=True)
     write_lux(prefix + LUX_SUFFIX, g)
     np.savetxt(prefix + ".feats.csv", feats, delimiter=",", fmt="%.6g")
+    # Also write the binary cache the loader would otherwise build on
+    # first read: saves the O(N*D) CSV parse, and (written after the CSV,
+    # so _cache_fresh accepts it) preserves EXACT float32 values where
+    # the %.6g text round-trip would quantize.
+    np.ascontiguousarray(feats, np.float32).tofile(prefix + ".feats.bin")
     np.savetxt(prefix + ".label", label_ids.reshape(-1, 1), fmt="%d")
     with open(prefix + ".mask", "w") as f:
         for m in mask:

@@ -170,17 +170,19 @@ def test_convert_rocfile_reorder_roundtrip(tmp_path):
     yield an ISOMORPHIC dataset — same losses, features/labels/mask
     moved with their vertices — plus the transpose sidecar."""
     import os
+    import subprocess
     import sys
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
-                                    "tools"))
-    import importlib
-    cvt = importlib.import_module("convert")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "convert.py")
     a = str(tmp_path / "a")
     b = str(tmp_path / "b")
-    assert cvt.main(["lesmis", "-o", a]) == 0
-    assert cvt.main(["rocfile", "--file", a, "--in-dim", "77",
-                     "--classes", "5", "-o", b, "--reorder",
-                     "--with-transpose"]) == 0
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    assert subprocess.run([sys.executable, tool, "lesmis", "-o", a],
+                          env=env).returncode == 0
+    assert subprocess.run([sys.executable, tool, "rocfile", "--file", a,
+                           "--in-dim", "77", "--classes", "5", "-o", b,
+                           "--reorder", "--with-transpose"],
+                          env=env).returncode == 0
     assert os.path.exists(b + lux.TLUX_SUFFIX)
     da = datasets.load_roc_dataset(a, 77, 5)
     db = datasets.load_roc_dataset(b, 77, 5)

@@ -130,8 +130,13 @@ def main(argv=None) -> int:
         ds, _, note = maybe_reorder_dataset(ds, a.reorder)
         print(f"# {note}", file=sys.stderr)
     convert.write(ds, a.out)
-    if a.with_transpose:
-        from roc_tpu.graph import lux
+    from roc_tpu.graph import lux
+    # Refresh the transpose sidecar whenever one exists at the output
+    # prefix, not only under --with-transpose: a rewrite (esp. --reorder)
+    # would otherwise leave a stale .t.lux that PASSES shard_load's
+    # header check (node/edge counts are permutation-invariant) and
+    # silently corrupts -edge-shard -perhost backward blocks.
+    if a.with_transpose or os.path.exists(a.out + lux.TLUX_SUFFIX):
         lux.write_transpose(a.out, ds.graph)
         print(f"wrote {a.out}{lux.TLUX_SUFFIX} (transposed sidecar)",
               file=sys.stderr)
