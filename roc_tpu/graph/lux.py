@@ -229,7 +229,8 @@ def write_dataset(prefix: str, g: Csr, feats: np.ndarray, label_ids: np.ndarray,
     # first read: saves the O(N*D) CSV parse, and (written after the CSV,
     # so _cache_fresh accepts it) preserves EXACT float32 values where
     # the %.6g text round-trip would quantize.
-    np.ascontiguousarray(feats, np.float32).tofile(prefix + ".feats.bin")
+    _atomic_tofile(np.ascontiguousarray(feats, np.float32),
+                   prefix + ".feats.bin")
     np.savetxt(prefix + ".label", label_ids.reshape(-1, 1), fmt="%d")
     with open(prefix + ".mask", "w") as f:
         for m in mask:

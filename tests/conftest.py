@@ -15,9 +15,19 @@ factories before first use.
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8").strip()
+_flags = os.environ.get("XLA_FLAGS", "").split()
+_flags.append("--xla_force_host_platform_device_count=8")
+# XLA:CPU hard-kills the process (rendezvous.cc "Termination timeout ...
+# Exiting") when a collective's device threads skew more than 40 s apart
+# — on a 1-core box running 8 virtual devices over 1e8-edge shards that
+# skew is routine, and the giant scale-guard programs aborted
+# intermittently (~50%) until these were raised.  Pre-set values win
+# (only appended when absent), so an operator can still tighten them.
+for _d in ("--xla_cpu_collective_call_terminate_timeout_seconds=1200",
+           "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120"):
+    if not any(f.startswith(_d.split("=")[0]) for f in _flags):
+        _flags.append(_d)
+os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import jax  # noqa: E402
 

@@ -191,26 +191,8 @@ def test_products_shape_perhost_end_to_end(tmp_path):
           f"peak {_peak_rss_gb():.1f} GB")
 
 
-@pytest.fixture
-def no_compile_cache():
-    """Disable the persistent XLA compile cache for one test.
-
-    Found 2026-08-01: the papers16 rehearsal's 8-layer perhost program
-    compiles, persists, and runs fine — but the CACHE-DESERIALIZED
-    executable aborts the process inside ThunkExecutor on the next run
-    (reproduced twice; fresh compiles of the same program pass with
-    identical losses).  An XLA-CPU serialization bug at this program
-    size, so the big-program tests opt out of the cache rather than
-    flake every second run."""
-    import jax
-    old = jax.config.jax_compilation_cache_dir
-    jax.config.update("jax_compilation_cache_dir", None)
-    yield
-    jax.config.update("jax_compilation_cache_dir", old)
-
-
 @pytest.mark.slow
-def test_papers100m_sixteenth_rehearsal(tmp_path, no_compile_cache):
+def test_papers100m_sixteenth_rehearsal(tmp_path):
     """The papers100M configuration at 1/16 linear scale, end to end
     (VERDICT r3 item 7): 6.94M nodes / 2.09e8 edges written in the
     on-disk format, loaded perhost (graph stub + byte-range reads), and an
