@@ -224,13 +224,16 @@ def write_dataset(prefix: str, g: Csr, feats: np.ndarray, label_ids: np.ndarray,
     if parent:
         os.makedirs(parent, exist_ok=True)
     write_lux(prefix + LUX_SUFFIX, g)
-    np.savetxt(prefix + ".feats.csv", feats, delimiter=",", fmt="%.6g")
+    # %.9g is FLT_DECIMAL_DIG significant digits: every float32 round-trips
+    # the text exactly, so a consumer that loses the .bin sidecar and
+    # reparses the CSV gets bit-identical features to a cache-hit load
+    # (with %.6g the two representations diverged in the last ulp).
+    feats32 = np.ascontiguousarray(feats, np.float32)
+    np.savetxt(prefix + ".feats.csv", feats32, delimiter=",", fmt="%.9g")
     # Also write the binary cache the loader would otherwise build on
-    # first read: saves the O(N*D) CSV parse, and (written after the CSV,
-    # so _cache_fresh accepts it) preserves EXACT float32 values where
-    # the %.6g text round-trip would quantize.
-    _atomic_tofile(np.ascontiguousarray(feats, np.float32),
-                   prefix + ".feats.bin")
+    # first read: saves the O(N*D) CSV parse (written after the CSV, so
+    # _cache_fresh accepts it).
+    _atomic_tofile(feats32, prefix + ".feats.bin")
     np.savetxt(prefix + ".label", label_ids.reshape(-1, 1), fmt="%d")
     with open(prefix + ".mask", "w") as f:
         for m in mask:

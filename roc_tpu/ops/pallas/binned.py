@@ -254,8 +254,18 @@ def _cell_counts(edge_src: np.ndarray, edge_dst: np.ndarray,
     blk = np.asarray(edge_src, np.int64) // sb
     bn = np.asarray(edge_dst, np.int64) // rb
     nbins = int(bn.max(initial=0)) + 1
-    cnt = np.bincount(blk * nbins + bn)
-    return cnt[cnt > 0]
+    keys = blk * nbins + bn
+    nkeys = int(blk.max(initial=0) + 1) * nbins
+    if nkeys <= max(4 * len(keys), 1 << 20):
+        # dense O(E + cells) bincount while the cell table is small
+        cnt = np.bincount(keys, minlength=0)
+        return cnt[cnt > 0]
+    # Sparse O(E log E) time / O(E) memory fallback: a dense bincount is
+    # O(blocks*bins) memory regardless of occupancy — ~376 GB at papers100M
+    # scale with sb=rb=512, which would OOM exactly the offline
+    # preprocessing paths (-reorder auto, convert --reorder) advertised
+    # for such graphs.
+    return np.unique(keys, return_counts=True)[1]
 
 
 def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,

@@ -71,6 +71,14 @@ def test_dataset_files_roundtrip(tmp_path):
     assert (tmp_path / "t.feats.bin").exists()
     ds3 = datasets.load_roc_dataset(prefix, ds.in_dim, ds.num_classes)
     np.testing.assert_allclose(ds3.features, ds.features, rtol=1e-5)
+    # A consumer that lost the .bin sidecar and reparses the CSV must get
+    # BIT-identical features to the cache-hit load (the CSV is written at
+    # %.9g = exact float32 round-trip) — runs on "the same dataset" may
+    # never diverge based on which file happened to be read.
+    cached = ds3.features.copy()
+    (tmp_path / "t.feats.bin").unlink()
+    ds4 = datasets.load_roc_dataset(prefix, ds.in_dim, ds.num_classes)
+    assert np.array_equal(ds4.features, cached)
 
 
 def test_edge_balanced_bounds_matches_reference_rule():

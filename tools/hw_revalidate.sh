@@ -1,14 +1,25 @@
 #!/bin/bash
 # One-shot hardware revalidation after a tunnel outage (or a new round).
-# Runs in order, stopping notes into /tmp/hw_revalidate.log:
-#   1. TPU-gated kernel tests (incl. H=41, fallback kernel, avg)
-#   2. bench.py on auto (binned where viable) — the headline number
-#   3. group-count sweep via ROC_BINNED_GROUP_ROWS
-#   4. constant sweep round 2 (subprocess-isolated)
-# Usage:  bash tools/hw_revalidate.sh  (from the repo root, tunnel healthy)
+#
+# ORDERING CONTRACT (VERDICT r4 weak #3): the first thing a window buys is
+# the canonical bench of shipped defaults — round 2's only window was
+# ~40 min and four rounds produced null driver artifacts while this script
+# spent its first ~20 min on kernel tests.  Steps, highest-value first:
+#   1. bench.py on shipped defaults (SLOT=128, auto-geometry) — headline
+#   2. products-shape A/B (matmul vs auto-binned vs +reorder)
+#   3. fp32-exact + GAT + overcommit benches
+#   4. TPU-gated kernel tests
+#   5. group-count / constant / sparse-preset sweeps
+# Each step is timeout-guarded so a wedged compile can't eat the window.
+# Usage:  bash tools/hw_revalidate.sh [start-step]  (from repo root)
 set -u
 cd "$(dirname "$0")/.."
 LOG=/tmp/hw_revalidate.log
+START=${1:-1}
+case "$START" in
+    [1-5]) ;;
+    *) echo "usage: $0 [start-step 1-5]" >&2; exit 2 ;;
+esac
 : > "$LOG"
 
 note() { echo "== $*" | tee -a "$LOG"; }
@@ -17,36 +28,14 @@ note "probe"
 timeout 60 python -c "import jax; print(jax.devices())" 2>&1 | tail -1 \
     | tee -a "$LOG" || { note "tunnel down; aborting"; exit 1; }
 
-note "1. TPU-gated kernel tests"
-PYTHONPATH=/root/.axon_site:$PWD timeout 1200 python tests/test_tpu_hw.py \
-    2>&1 | tail -3 | tee -a "$LOG"
-
-note "2. bench auto (expect binned, ~0.7 s/epoch)"
+if [ "$START" -le 1 ]; then
+note "1. bench shipped defaults (THE headline; expect binned, ~0.63 s/epoch)"
 timeout 1800 python bench.py 2>&1 | tail -3 | tee -a "$LOG"
+fi
 
-note "2a. fp32-exact epoch on the binned kernels (target: <= 1.0 s)"
-ROC_BENCH_PRECISION=exact ROC_BENCH_BACKEND=binned ROC_BENCH_EPOCHS=5 \
-    timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
-
-note "2b. GAT epoch, plan-backend attention (target: within ~2x of GCN)"
-ROC_BENCH_MODEL=gat ROC_BENCH_LAYERS=602-64-41 ROC_BENCH_HEADS=4 \
-    ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
-    | tail -2 | tee -a "$LOG"
-
-note "2c. overcommit: 4 parts on the 1 bench chip (first hardware run of"
-note "    the multi-part paths: halo all_to_all, per-part plans, psum)"
-timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
-    -e 10 -parts 4 -v 2>&1 | tail -2 | tee -a "$LOG"
-timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
-    -e 10 -parts 4 -no-halo -v 2>&1 | tail -2 | tee -a "$LOG"
-# sharded GAT on the single chip (overcommit + plan attention) — the
-# round-2 "sharded GAT hardware perf unmeasured" gap
-timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-64-41 \
-    -e 10 -parts 4 -model gat -heads 2 -aggr-backend matmul -v 2>&1 \
-    | tail -2 | tee -a "$LOG"
-
-note "2d. products-shape single-chip A/B (the north-star graph, VERDICT r4:"
-note "    measure matmul vs binned-auto-geometry; record winner in BASELINE)"
+if [ "$START" -le 2 ]; then
+note "2. products-shape single-chip A/B (the north-star graph:"
+note "   matmul vs binned-auto-geometry vs +RCM-reorder)"
 PROD="env ROC_BENCH_SHAPE=products ROC_BENCH_NODES=2449029 ROC_BENCH_DEG=51"
 PROD="$PROD ROC_BENCH_LAYERS=100-256-47 ROC_BENCH_EPOCHS=5"
 for be in matmul auto; do
@@ -55,7 +44,6 @@ for be in matmul auto; do
 done
 # with the RCM locality pass (auto keeps the order only on a measured
 # padded-row gain): choose_geometry should then pick a binned geometry
-# (graph/reorder.py) — the candidate winner for the north star
 $PROD ROC_BENCH_BACKEND=auto ROC_BENCH_REORDER=auto timeout 3000 \
     python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 # hierarchical-locality variant (inter edges ring-adjacent, the structure
@@ -65,21 +53,51 @@ for rr in 0 auto; do
     $PROD ROC_BENCH_BACKEND=auto ROC_BENCH_INTER=ring ROC_BENCH_REORDER=$rr \
         timeout 3000 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 done
+fi
 
-note "3. group-count sweep (fewer groups -> less phase-1 rounding)"
+if [ "$START" -le 3 ]; then
+note "3a. fp32-exact epoch on the binned kernels (target: <= 1.0 s)"
+ROC_BENCH_PRECISION=exact ROC_BENCH_BACKEND=binned ROC_BENCH_EPOCHS=5 \
+    timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+
+note "3b. GAT epoch, plan-backend attention (target: within ~2x of GCN)"
+ROC_BENCH_MODEL=gat ROC_BENCH_LAYERS=602-64-41 ROC_BENCH_HEADS=4 \
+    ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
+
+note "3c. overcommit: 4 parts on the 1 bench chip (multi-part paths:"
+note "    halo all_to_all, per-part plans, psum)"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -v 2>&1 | tail -2 | tee -a "$LOG"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -no-halo -v 2>&1 | tail -2 | tee -a "$LOG"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-64-41 \
+    -e 10 -parts 4 -model gat -heads 2 -aggr-backend matmul -v 2>&1 \
+    | tail -2 | tee -a "$LOG"
+fi
+
+if [ "$START" -le 4 ]; then
+note "4. TPU-gated kernel tests (incl. H=41, fallback kernel, avg)"
+PYTHONPATH=/root/.axon_site:$PWD timeout 1200 python tests/test_tpu_hw.py \
+    2>&1 | tail -3 | tee -a "$LOG"
+fi
+
+if [ "$START" -le 5 ]; then
+note "5. group-count sweep (fewer groups -> less phase-1 rounding)"
 for grt in 2097152 4194304 8388608; do
     note "   ROC_BINNED_GROUP_ROWS=$grt"
     ROC_BINNED_GROUP_ROWS=$grt ROC_BENCH_BACKEND=binned \
         timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 done
 
-note "4. constant sweep round 2"
+note "5b. constant sweep round 2"
 timeout 5400 python tools/sweep_binned.py 2>&1 | tee -a "$LOG"
 
-note "4b. sparse-preset sweep at products shape (re-fit choose_geometry's"
+note "5c. sparse-preset sweep at products shape (re-fit choose_geometry's"
 note "    cost model constants from whatever this measures)"
 SWEEP_SHAPE=products SWEEP_N=2449029 SWEEP_E=125000000 SWEEP_TIMEOUT_S=1800 \
     timeout 6000 python tools/sweep_binned.py 2>&1 | tee -a "$LOG"
+fi
 
 note "done — record winners in docs/PERF.md + BASELINE.md, update"
 note "ROC_BINNED_GROUP_ROWS default / native BN_* constants if changed"
