@@ -66,6 +66,13 @@ class ShardedGraphData:
     plans: object = None             # stacked AggregatePlans ([P, ...] axes)
     gat_plans: object = None         # stacked ops.edge.GatPlans
     ring_plans: object = None        # ring.RingPlans ([P, P, ...] axes)
+    # Halo-overlap split (vertex halo mode): local-source edges aggregate
+    # over x's own S rows while the all_to_all is in flight; remote-source
+    # edges aggregate over the received [P*K] halo rows afterwards.  When
+    # set, `plans` stays None (sum/avg never build the combined table
+    # schedule; max/min and attention keep the table path).
+    plans_local: object = None       # plans over table = own [S] rows
+    plans_remote: object = None      # plans over table = halo [P*K] rows
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
     mode: str = dataclasses.field(default="vertex",
                                   metadata={"static": True})
@@ -76,7 +83,8 @@ class ShardedGraphData:
 jax.tree_util.register_dataclass(
     ShardedGraphData,
     data_fields=["edge_src", "edge_dst", "in_degree", "send_idx",
-                 "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans"],
+                 "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans",
+                 "plans_local", "plans_remote"],
     meta_fields=["backend", "mode", "precision"])
 
 
@@ -546,20 +554,55 @@ from roc_tpu.graph.shard_load import allgather_floors as _allgather_floors  # no
 from roc_tpu.ops.edge import _Z_GUARD  # noqa: E402  (guard rationale there)
 
 
+def _build_shard_plans_split(backend: str, srcs, dsts, S: int,
+                             halo_rows: int, allgather=None):
+    """(plans_local, plans_remote) for the halo-overlap aggregation.
+
+    Each shard's edge list is cut by source residence: table-local ids
+    < S read the shard's own rows (no communication), ids >= S read the
+    received halo block (shifted to be [0, P*K)-local).  Aggregating the
+    local set while the all_to_all is in flight is the TPU-explicit form
+    of the pipelining Legion gives the reference implicitly — its async
+    IndexLaunchers overlap each op's data movement with compute
+    (scattergather.cc:49-81, SURVEY §3.2).
+
+    Pad edges (source at an own-shard pad node, partition.py) land in the
+    local set by construction, so the remote set carries live halo edges
+    only.  Sum split = exact up to fp32 reassociation, the same freedom
+    the combined plan already exercises across its chunks."""
+    loc_s, loc_d, rem_s, rem_d = [], [], [], []
+    for i in range(len(srcs)):
+        si = np.asarray(srcs[i])
+        di = np.asarray(dsts[i])
+        m = si < S
+        loc_s.append(si[m].astype(np.int32))
+        loc_d.append(di[m].astype(np.int32))
+        rem_s.append((si[~m] - S).astype(np.int32))
+        rem_d.append(di[~m].astype(np.int32))
+    return (_build_shard_plans(backend, loc_s, loc_d, S, S, allgather),
+            _build_shard_plans(backend, rem_s, rem_d, S, halo_rows,
+                               allgather))
+
+
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
                 backend: str = "xla",
                 precision: str = "exact",
-                gat_backend: str = "xla") -> ShardedGraphData:
+                gat_backend: str = "xla",
+                halo_overlap: bool = False) -> ShardedGraphData:
     if halo is not None:
         src = halo.edge_src_local
     else:
         src = part.edge_src.astype(np.int32)
     P_, S = part.num_parts, part.shard_nodes
     table_rows = S + P_ * halo.K if halo is not None else P_ * S
-    plans = None
+    plans = plans_local = plans_remote = None
     if backend in ("matmul", "binned"):
-        plans = _build_shard_plans(backend, src, part.edge_dst, S,
-                                   table_rows)
+        if halo is not None and halo_overlap:
+            plans_local, plans_remote = _build_shard_plans_split(
+                backend, src, part.edge_dst, S, P_ * halo.K)
+        else:
+            plans = _build_shard_plans(backend, src, part.edge_dst, S,
+                                       table_rows)
     gat_plans = None
     if gat_backend == "plan":
         from roc_tpu.ops.edge import build_gat_plans, pad_gat_plans
@@ -573,6 +616,8 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         send_idx=None if halo is None else jnp.asarray(halo.send_idx),
         plans=plans,
         gat_plans=gat_plans,
+        plans_local=plans_local,
+        plans_remote=plans_remote,
         backend=backend,
         precision=precision,
     )
@@ -868,6 +913,25 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
     def aggregate(x, aggr):
         # avg rides the sum fast path: per-shard in_degree is the live
         # in-edge count (pad rows carry 1, and their sums are zero anyway).
+        if gd_block.plans_local is not None and aggr in ("sum", "avg"):
+            # Halo overlap: issue the all_to_all FIRST, aggregate the
+            # local-source edges while it is in flight (the local plan
+            # consumes only x, so XLA's async collective scheduler runs
+            # the send concurrently with the local matmuls), then fold the
+            # remote-source contributions from the received halo rows —
+            # the explicit form of the reference's Legion pipelining
+            # (scattergather.cc:49-81 async IndexLaunchers).
+            send = jnp.take(x, gd_block.send_idx, axis=0)        # [P, K, H]
+            recv = jax.lax.all_to_all(send, PARTS_AXIS,
+                                      split_axis=0, concat_axis=0)
+            out = _plan_sum(x, gd_block.plans_local, gd_block.backend,
+                            gd_block.precision, shard_nodes, interp)
+            out = out + _plan_sum(recv.reshape(-1, x.shape[-1]),
+                                  gd_block.plans_remote, gd_block.backend,
+                                  gd_block.precision, shard_nodes, interp)
+            if aggr == "avg":
+                out = ops.divide_by_degree(out, gd_block.in_degree)
+            return out
         table = _exchange(gd_block, exchange, x)
         return _vertex_aggregate(table, gd_block, shard_nodes, aggr, interp)
 
@@ -887,18 +951,23 @@ def _part_view(tree_, j: int):
     return jax.tree.map(lambda a: a[j], tree_)
 
 
+def _plan_sum(table, plans, backend: str, precision: str, S: int,
+              interp: bool):
+    """Sum-aggregate ``table`` through one stacked plan set (the backend
+    dispatch shared by the combined-table and halo-overlap paths)."""
+    if backend == "binned":
+        return ops.scatter_gather_binned(table, plans, interp, precision)
+    return ops.scatter_gather_matmul(table, plans, S, table.shape[0],
+                                     ops.matmul_precision(precision))
+
+
 def _vertex_aggregate(table, gdj, S: int, aggr: str, interp: bool):
     """One part's vertex-mode aggregation over its source table — the
     single backend dispatch shared by _shard_gctx (k=1) and
     _shard_gctx_over (k parts stacked per device)."""
     if gdj.plans is not None and aggr in ("sum", "avg"):
-        if gdj.backend == "binned":
-            out = ops.scatter_gather_binned(table, gdj.plans, interp,
-                                            gdj.precision)
-        else:
-            out = ops.scatter_gather_matmul(
-                table, gdj.plans, S, table.shape[0],
-                ops.matmul_precision(gdj.precision))
+        out = _plan_sum(table, gdj.plans, gdj.backend, gdj.precision, S,
+                        interp)
         if aggr == "avg":
             out = ops.divide_by_degree(out, gdj.in_degree)
         return out
@@ -1035,6 +1104,14 @@ class SpmdTrainer(BaseTrainer):
             "process-major")
         return ids
 
+    def _halo_overlap(self) -> bool:
+        """Build split local/remote plans for the halo exchange?  On by
+        default (cfg.halo_overlap) for the plan backends in vertex halo
+        mode; overcommit (k>1) keeps the combined table — its k per-part
+        aggregations already interleave with the single all_to_all."""
+        return bool(self.config.halo_overlap) and self.k == 1 \
+            and self._exchange_mode == "halo"
+
     def _build_graph_full(self, backend: str,
                           gat_backend: str = "xla") -> ShardedGraphData:
         """Single-host path: whole graph in memory, all P parts built."""
@@ -1107,7 +1184,8 @@ class SpmdTrainer(BaseTrainer):
                     S_, table_rows, int(self.part.num_edges_valid.max())):
                 backend = "binned"
         return shard_graph(self.part, self.halo, backend,
-                           cfg.aggregate_precision, gat_backend=gat_backend)
+                           cfg.aggregate_precision, gat_backend=gat_backend,
+                           halo_overlap=self._halo_overlap())
 
     def _build_graph_perhost(self, backend: str,
                              gat_backend: str = "xla") -> ShardedGraphData:
@@ -1213,10 +1291,15 @@ class SpmdTrainer(BaseTrainer):
         P_, S = meta.num_parts, meta.shard_nodes
         src = lhalo.edge_src_local if lhalo is not None else local.edge_src
         table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
-        plans = None
+        plans = plans_local = plans_remote = None
         if backend in ("matmul", "binned"):
-            plans = _build_shard_plans(backend, src, local.edge_dst, S,
-                                       table_rows, allgather=ag)
+            if lhalo is not None and self._halo_overlap():
+                plans_local, plans_remote = _build_shard_plans_split(
+                    backend, src, local.edge_dst, S, P_ * lhalo.K,
+                    allgather=ag)
+            else:
+                plans = _build_shard_plans(backend, src, local.edge_dst, S,
+                                           table_rows, allgather=ag)
         gat_plans = None
         if gat_backend == "plan":
             from roc_tpu.ops.edge import build_gat_plans, pad_gat_plans
@@ -1234,6 +1317,8 @@ class SpmdTrainer(BaseTrainer):
             send_idx=None if lhalo is None else jnp.asarray(lhalo.send_idx),
             plans=plans,
             gat_plans=gat_plans,
+            plans_local=plans_local,
+            plans_remote=plans_remote,
             backend=backend,
             precision=cfg.aggregate_precision)
 

@@ -377,3 +377,122 @@ def test_ring_exchange_sage_avg_and_max():
             l1, lr = float(t1.run_epoch()), float(tr.run_epoch())
             np.testing.assert_allclose(lr, l1, rtol=rtol,
                                        err_msg=f"{aggr} epoch {i}")
+
+
+# ---------------------------------------------------------------------------
+# Halo overlap (round 5): local-source edges aggregate while the all_to_all
+# is in flight — the explicit TPU form of the reference's Legion pipelining
+# (scattergather.cc:49-81 async IndexLaunchers; SURVEY §3.2).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["matmul", "binned"])
+def test_halo_overlap_matches_combined_table(backend):
+    """Split local/remote plans == combined-table plans, fwd AND bwd
+    (training epochs), on both plan backends."""
+    from roc_tpu.models import build_sage
+
+    ds = small_ds(seed=23)
+    base = dict(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=3,
+                dropout_rate=0.0, eval_every=10**9, num_parts=4, halo=True,
+                edge_shard="off", aggregate_backend=backend)
+    on = SpmdTrainer(Config(**base), ds,
+                     build_gcn(base["layers"], 0.0))
+    off = SpmdTrainer(Config(**base, halo_overlap=False), ds,
+                      build_gcn(base["layers"], 0.0))
+    assert on.gdata.plans_local is not None \
+        and on.gdata.plans_remote is not None and on.gdata.plans is None
+    assert off.gdata.plans is not None and off.gdata.plans_local is None
+    for i in range(3):
+        l_on, l_off = float(on.run_epoch()), float(off.run_epoch())
+        np.testing.assert_allclose(l_on, l_off, rtol=1e-5,
+                                   err_msg=f"epoch {i}")
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(on.params["linear_1"])),
+        np.asarray(jax.device_get(off.params["linear_1"])), rtol=1e-4,
+        atol=1e-6)
+    # avg (SAGE) rides the same split then divides by degree
+    m_on = SpmdTrainer(Config(**base, model="sage", aggr="avg"), ds,
+                       build_sage(base["layers"], 0.0, aggr="avg"))
+    m_off = SpmdTrainer(Config(**base, model="sage", aggr="avg",
+                               halo_overlap=False), ds,
+                        build_sage(base["layers"], 0.0, aggr="avg"))
+    for i in range(2):
+        np.testing.assert_allclose(float(m_on.run_epoch()),
+                                   float(m_off.run_epoch()), rtol=1e-5,
+                                   err_msg=f"sage epoch {i}")
+
+
+def test_halo_overlap_local_dots_independent_of_collective():
+    """The POINT of the split: the local-plan matmuls must not depend on
+    the all_to_all's result, or XLA cannot overlap them.  Verified on the
+    traced jaxpr of the aggregation: collect every var transitively
+    derived from the all_to_all output and assert at least one
+    dot_general consumes none of them (the local one-hot dots), while at
+    least one does (the remote fold)."""
+    from roc_tpu.parallel import spmd as sp
+
+    ds = small_ds(seed=29)
+    cfg = Config(layers=[ds.in_dim, 8, ds.num_classes], num_epochs=1,
+                 dropout_rate=0.0, eval_every=10**9, num_parts=4, halo=True,
+                 edge_shard="off", aggregate_backend="matmul")
+    tr = SpmdTrainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    gd = tr.gdata
+    S = tr.part.shard_nodes
+
+    def one_shard_aggregate(x, gd_block):
+        gctx = sp._shard_gctx(gd_block, S, "halo")
+        return gctx.aggregate(x, "sum")
+
+    x = jax.ShapeDtypeStruct((S, ds.in_dim), jax.numpy.float32)
+    import jax.numpy as jnp
+
+    def wrapped(x, gd_arrays):
+        gd_block = jax.tree.util.tree_unflatten(gd_treedef, gd_arrays)
+        return one_shard_aggregate(x, gd_block)
+
+    gd_one = jax.tree.map(lambda a: a[0], gd)   # squeeze the parts axis
+    gd_arrays, gd_treedef = jax.tree.util.tree_flatten(gd_one)
+    with jax.sharding.Mesh(np.array(jax.devices()[:4]), ("parts",)):
+        jaxpr = jax.make_jaxpr(
+            lambda x, arrs: jax.shard_map(
+                lambda x_, *a: wrapped(x_, list(a)),
+                in_specs=(jax.sharding.PartitionSpec(),) * (1 + len(gd_arrays)),
+                out_specs=jax.sharding.PartitionSpec(),
+                check_vma=False,
+            )(x_=x, *arrs) if False else wrapped(x, arrs)
+        )(x, gd_arrays)
+
+    # walk the jaxpr (including sub-jaxprs) flattening to a linear eqn list
+    eqns = []
+
+    def collect(jx):
+        for e in jx.eqns:
+            eqns.append(e)
+            for v in e.params.values():
+                if hasattr(v, "jaxpr"):
+                    collect(v.jaxpr)
+                if isinstance(v, (list, tuple)):
+                    for vv in v:
+                        if hasattr(vv, "jaxpr"):
+                            collect(vv.jaxpr)
+    collect(jaxpr.jaxpr)
+
+    tainted = set()
+    saw_a2a = saw_clean_dot = saw_tainted_dot = False
+    for e in eqns:
+        invars = [str(v) for v in e.invars if hasattr(v, "aval")]
+        is_tainted = any(v in tainted for v in invars)
+        if "all_to_all" in e.primitive.name:
+            saw_a2a = True
+            is_tainted = True
+        if is_tainted:
+            tainted.update(str(v) for v in e.outvars)
+        if e.primitive.name == "dot_general":
+            if is_tainted:
+                saw_tainted_dot = True
+            else:
+                saw_clean_dot = True
+    assert saw_a2a, "no all_to_all in the overlap aggregation"
+    assert saw_clean_dot, ("every dot_general depends on the collective — "
+                           "the local aggregation cannot overlap it")
+    assert saw_tainted_dot, "no dot consumes the halo rows (remote fold lost)"

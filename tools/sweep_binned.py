@@ -43,14 +43,21 @@ CONFIGS_PRODUCTS = [
 ]
 
 # (SB, CH, SLOT, RB, CH2, group_row_target)
+# Round-5 CPU plan-statistics study (BASELINE.md round-5 notes): at Reddit
+# shape, CH=4096 + grt=2^23 cuts phase-1 grid steps 50% (16512 -> 8208)
+# and CH2=8192 cuts phase-2 steps 49% (7692 -> 3891); both phases were
+# measured per-grid-step-overhead-bound (docs/PERF.md), so the chunk-count
+# cut is the modeled 310 -> 257 ms lever.  RB=256 and SB=1024 LOSE on the
+# model (slot-padding x2.6 / MAC-bound) and are kept as controls.  CH2=8192
+# failed round 2 as an opaque tunnel 500 — capture the real Mosaic error.
 CONFIGS = [
-    (512, 2048, 128, 512, 4096, 1 << 21),   # shipped defaults
-    (512, 2048, 128, 512, 4096, 1 << 22),   # fewer groups, less rounding
-    (512, 2048, 128, 512, 4096, 1 << 23),
-    (512, 1024, 128, 512, 4096, 1 << 22),   # smaller chunks, less rounding
-    (512, 1024, 64, 512, 4096, 1 << 22),
-    (512, 2048, 128, 256, 4096, 1 << 22),   # smaller bins (less VPU)
-    (256, 2048, 128, 512, 4096, 1 << 22),   # smaller source blocks
+    (512, 2048, 128, 512, 4096, 1 << 21),   # shipped defaults (baseline)
+    (512, 2048, 128, 512, 4096, 1 << 23),   # fewer groups only
+    (512, 4096, 128, 512, 4096, 1 << 23),   # -50% phase-1 chunks
+    (512, 4096, 128, 512, 8192, 1 << 23),   # + -49% phase-2 chunks
+    (512, 4096, 128, 512, 8192, 1 << 21),   # big chunks, small staging
+    (512, 2048, 128, 256, 4096, 1 << 22),   # control: model says lose
+    (1024, 4096, 128, 512, 8192, 1 << 23),  # control: model says MAC-bound
 ]
 
 
