@@ -29,6 +29,14 @@ Env knobs:
   ROC_BENCH_SCALE    graph-size multiplier for smoke tests (default 1.0;
                      the canonical metric requires 1.0 — smaller scales
                      annotate the metric name)
+  ROC_BENCH_SHAPE    reddit (default) | products: full shape preset —
+                     nodes, degree AND layers default per shape, so
+                     `ROC_BENCH_SHAPE=products python bench.py` is the
+                     whole north-star invocation
+  ROC_BENCH_AB       comma list of backends, e.g. "matmul,binned": measure
+                     every leg in THIS process (same dataset/warmup, per-
+                     epoch times in the artifact); value = slowest/fastest
+                     ratio, unit "x" — the forced-vs-auto anomaly check
 """
 
 import json
@@ -60,7 +68,17 @@ SCALE = _env("ROC_BENCH_SCALE", "1.0", float)
 # ROC_BENCH_SHAPE only labels the metric; vs_baseline stays null off the
 # canonical reddit shape (the reference figure is a Reddit number).
 SHAPE = os.environ.get("ROC_BENCH_SHAPE", "reddit")
-NODES = int(_env("ROC_BENCH_NODES", str(232_965), int) * SCALE)
+# Shape presets: `ROC_BENCH_SHAPE=products python bench.py` is the whole
+# north-star invocation — nodes/degree/layers default per shape (explicit
+# ROC_BENCH_NODES/DEG/LAYERS still override).  Unknown shape names keep
+# the reddit defaults (the name only labels the metric).
+_SHAPE_DEFAULTS = {
+    "reddit": (str(232_965), "50.0", [602, 256, 41]),
+    "products": (str(2_449_029), "51.0", [100, 256, 47]),
+}
+_DEF_NODES, _DEF_DEG, _DEF_LAYERS = _SHAPE_DEFAULTS.get(
+    SHAPE, _SHAPE_DEFAULTS["reddit"])
+NODES = int(_env("ROC_BENCH_NODES", _DEF_NODES, int) * SCALE)
 # ROC_BENCH_MODEL=gat measures the attention path (plan backend on TPU);
 # non-gcn runs annotate the metric name and report vs_baseline null (the
 # reference figure is a GCN number).  ROC_BENCH_LAYERS overrides the hidden
@@ -70,11 +88,11 @@ MODEL = os.environ.get("ROC_BENCH_MODEL", "gcn")
 HEADS = _env("ROC_BENCH_HEADS", "4", int)
 _layers_env = os.environ.get("ROC_BENCH_LAYERS", "")
 LAYERS = [int(v) for v in _layers_env.split("-")] if _layers_env \
-    else [602, 256, 41]
+    else list(_DEF_LAYERS)
 # The synthetic graph's feature/class dims follow the layer spec (the
 # driver asserts they agree).
 IN_DIM, CLASSES = LAYERS[0], LAYERS[-1]
-AVG_DEG = _env("ROC_BENCH_DEG", "50.0", float)
+AVG_DEG = _env("ROC_BENCH_DEG", _DEF_DEG, float)
 WARMUP = 3
 MEASURED = _env("ROC_BENCH_EPOCHS", "10", int)
 BACKEND = os.environ.get("ROC_BENCH_BACKEND", "auto")
@@ -99,6 +117,16 @@ if REORDER not in ("off", "on", "auto"):
 # graphs have) instead of uniformly — the case a locality reorder can
 # exploit.  Annotates the metric; canonical stays uniform.
 INTER = os.environ.get("ROC_BENCH_INTER", "uniform")
+# ROC_BENCH_AB="matmul,binned" (any comma list of backends): measure every
+# leg in THIS process, same dataset, same warmup discipline, per-epoch
+# times in the artifact.  The round-5 forced-vs-auto anomaly (256 s vs
+# 30 s on byte-identical HLO, docs/PERF.md) was exactly cross-invocation
+# harness state — first-invocation compile/tunnel effects landing inside
+# the measured window of one leg and not the other.  A same-process A/B
+# removes that class of artifact by construction; the reported value is
+# the slowest/fastest leg ratio (unit "x", 1.0 = parity).
+AB = [s.strip() for s in os.environ.get("ROC_BENCH_AB", "").split(",")
+      if s.strip()]
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -362,6 +390,43 @@ def run():
         device_sync(loss)
         return tr
 
+    def measure(tr):
+        """Per-epoch wall times (host-synced each epoch).  The per-epoch
+        sync costs one device round trip (~ms against ~0.6 s epochs) and
+        buys the first-epoch-inflation visibility the round-5 anomaly
+        hunt needed — a wedged first invocation shows up as one outlier
+        sample instead of silently inflating the mean."""
+        import gc
+        gc.collect()               # no GC pause inside the measured loop
+        times = []
+        for _ in range(MEASURED):
+            t = time.perf_counter()
+            device_sync(tr.run_epoch())
+            times.append(time.perf_counter() - t)
+        return times
+
+    if AB:
+        legs = {}
+        for b in AB:
+            tr = build_and_warm(b)
+            times = measure(tr)
+            legs[b] = {
+                "value": round(sum(times) / len(times), 4),
+                "backend": tr.gdata.backend,
+                "epoch_s_min": round(min(times), 4),
+                "epoch_times": [round(t, 4) for t in times],
+            }
+            del tr                 # drop the leg's HBM before the next
+        vals = [leg["value"] for leg in legs.values()]
+        return {
+            "metric": METRIC + "_ab_" + "-vs-".join(AB),
+            "value": round(max(vals) / min(vals), 4),
+            "unit": "x",
+            "vs_baseline": None,
+            "platform": jax.default_backend(),
+            "ab": legs,
+        }
+
     fallback_from = None
     try:
         trainer = build_and_warm(BACKEND)
@@ -383,11 +448,8 @@ def run():
         fallback_from = type(e).__name__
     if fallback_from is not None:   # outside except: drop the failed
         trainer = build_and_warm(fb)         # trainer's HBM before rebuild
-    t1 = time.perf_counter()
-    for _ in range(MEASURED):
-        loss = trainer.run_epoch()
-    device_sync(loss)
-    epoch_s = (time.perf_counter() - t1) / MEASURED
+    times = measure(trainer)
+    epoch_s = sum(times) / len(times)
 
     edges_per_sec_per_chip = ds.graph.num_edges / epoch_s / n_dev
     resolved = trainer.gdata.backend  # what actually ran (auto resolves)
@@ -424,6 +486,11 @@ def run():
         "model_tflops_per_epoch": round(flops / 1e12, 4),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "roofline_frac": round(t_bound / epoch_s, 4) if on_tpu else None,
+        # per-epoch samples: outliers (first-invocation state, GC, tunnel
+        # hiccups) are visible instead of silently folded into the mean
+        "epoch_s_min": round(min(times), 4),
+        "epoch_s_max": round(max(times), 4),
+        "epoch_times": [round(t, 4) for t in times],
     }
     if fallback_from is not None:
         result["fallback"] = f"auto failed ({fallback_from}); ran {fb}"
@@ -437,6 +504,7 @@ def run():
             tmp = f"{LAST_HW_PATH}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(stamped, f, indent=1)
+                f.write("\n")           # committed file: POSIX text EOF
             os.replace(tmp, LAST_HW_PATH)
         except OSError:
             pass

@@ -1,0 +1,81 @@
+"""Polyfills so the jax-0.9-targeted codebase also runs on jax 0.4.x.
+
+The repo is written against the post-0.5 shard_map surface (`jax.shard_map`
+with `check_vma=`, `jax.lax.pcast`, `jax.typeof(...).vma`).  Some
+containers ship jax 0.4.37, where none of those exist — the varying-
+manual-axes (vma) type system hadn't landed yet and shard_map still lived
+in `jax.experimental.shard_map` with the older `check_rep=` mechanism.
+
+Rather than fork every call site, this module installs equivalents INTO
+the `jax` namespace on first import of `roc_tpu` (tests monkeypatch
+`jax.shard_map` directly, so the attribute must exist there).  On a jax
+that already provides an API the polyfill is skipped — this file is a
+no-op on 0.9+.
+
+Degradation contract on old jax:
+
+- ``jax.shard_map(..., check_vma=...)`` maps to the experimental
+  shard_map with ``check_rep=False``.  check_rep is NOT the same check:
+  it is a replication-inference pass with no rules for ``custom_vjp`` or
+  ``pallas_call``, so passing ``check_rep=check_vma`` rejects valid
+  programs this repo compiles under real vma checking.  Static vma
+  verification simply does not exist pre-0.5; callers still pass (and
+  tests still assert) the intended ``check_vma`` value so behavior is
+  unchanged the moment a modern jax is present.
+- ``jax.lax.pcast(x, axes, to="varying")`` is identity: with no vma
+  annotations there is nothing to promote.  All pcast call sites here
+  are promotions of replicated carries/inits (no gradient edge), which
+  are correct unannotated on old jax.
+- ``jax.typeof(x)`` returns the aval behind a proxy whose ``.vma`` is an
+  empty frozenset when the aval predates vma support.
+"""
+
+import functools
+
+import jax
+
+HAS_VMA = hasattr(jax, "shard_map")
+
+
+class _AvalProxy:
+    """Delegates to a pre-vma ShapedArray, adding an empty .vma."""
+
+    __slots__ = ("_aval",)
+    vma = frozenset()
+
+    def __init__(self, aval):
+        self._aval = aval
+
+    def __getattr__(self, name):
+        return getattr(self._aval, name)
+
+
+def _install():
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        @functools.wraps(_legacy)
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kw):
+            del check_vma  # no vma machinery on this jax (see module doc)
+            return _legacy(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axes, *, to="varying"):
+            del axes, to
+            return x
+
+        jax.lax.pcast = pcast
+
+    if not hasattr(jax, "typeof"):
+        def typeof(x):
+            aval = jax.core.get_aval(x)
+            return aval if hasattr(aval, "vma") else _AvalProxy(aval)
+
+        jax.typeof = typeof
+
+
+_install()

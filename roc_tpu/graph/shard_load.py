@@ -69,17 +69,23 @@ def jax_allgather() -> AllGather:
     import jax
     from jax.experimental import multihost_utils
 
+    def gather(x):
+        # Old jax (< 0.5) returns the bare array from a single-process
+        # gather; new jax always prepends a process axis.  Callers index
+        # [proc], so normalize to the process-axis form.
+        g = np.asarray(multihost_utils.process_allgather(x))
+        return g[None] if g.shape == x.shape else g
+
     def ag(x):
         x = np.asarray(x)
         if x.dtype == np.int64 and not jax.config.jax_enable_x64:
             hi = (x >> 32).astype(np.uint32)          # arithmetic shift
             lo = (x & 0xFFFFFFFF).astype(np.uint32)
-            g = np.asarray(multihost_utils.process_allgather(
-                np.stack([hi, lo], axis=-1)))
+            g = gather(np.stack([hi, lo], axis=-1))
             ghi = g[..., 0].astype(np.int64)
             ghi -= (ghi >> 31) << 32                  # re-sign the high word
             return (ghi << 32) | g[..., 1].astype(np.int64)
-        return np.asarray(multihost_utils.process_allgather(x))
+        return gather(x)
 
     return ag
 

@@ -274,7 +274,10 @@ def binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int,
         from roc_tpu.ops.pallas.binned import _default_geom
         geom = _default_geom()
     CH, CH2, NSLOT = geom.ch, geom.ch2, geom.nslot
-    geo5 = np.asarray(tuple(geom), np.int64)
+    # The C builders take only the five kernel-geometry fields; the policy
+    # fields (grt, hub_minc) shape group_row_target / the edge split on the
+    # Python side before this call.
+    geo5 = np.asarray(tuple(geom)[:5], np.int64)
     src = np.ascontiguousarray(edge_src, np.int64)
     dst = np.ascontiguousarray(edge_dst, np.int64)
     E = len(src)

@@ -317,9 +317,17 @@ class BinnedPlans(NamedTuple):
 
     Same role as :class:`AggregatePlans` for the plan-based one-hot
     backends; the payloads are :class:`roc_tpu.ops.pallas.binned.BinnedPlan`
-    dataclasses (registered pytrees with static geometry fields)."""
+    dataclasses (registered pytrees with static geometry fields).
+
+    ``mm`` (optional) is the matmul side of a HYBRID plan: on power-law
+    graphs the thin (sub-``hub_minc``) cells' edges pay less on the
+    per-edge one-hot matmul path than as slot padding, so choose_geometry
+    can split the edge list — dense hub cells stay binned, the tail rides
+    an :class:`AggregatePlans` whose output simply adds in.  A = A_dense +
+    A_thin, so fwd sums the two paths and bwd sums their transposes."""
     fwd: object
     bwd: object
+    mm: object = None
 
 
 def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
@@ -336,9 +344,13 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
     default where the model prefers matmul (the caller already chose
     binned).  A (fwd_spec, bwd_spec) pair sets each direction separately —
     resolve_backend_geom threads its already-chosen forward Geometry this
-    way so the O(E) statistics aren't recomputed."""
+    way so the O(E) statistics aren't recomputed.
+
+    A forward geometry with ``hub_minc`` set (choose_geometry's hybrid
+    verdict, or an explicit caller) splits the edges: the binned pair
+    covers only the dense-cell edges and ``mm`` carries the rest."""
     from roc_tpu.ops.pallas.binned import (_default_geom, build_binned_plan,
-                                           choose_geometry)
+                                           choose_geometry, split_hub_edges)
     fwd_spec, bwd_spec = geom if isinstance(geom, tuple) else (geom, geom)
 
     def pick(spec, src, dst, n, t):
@@ -347,13 +359,25 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
         g, _ = choose_geometry(src, dst, n, t, force=True)
         return g or _default_geom()
 
+    fwd_geom = pick(fwd_spec, edge_src, edge_dst, num_rows, table_rows)
+    es, ed = np.asarray(edge_src), np.asarray(edge_dst)
+    mm = None
+    if getattr(fwd_geom, "hub_minc", 0):
+        keep = split_hub_edges(es, ed, fwd_geom)
+        if keep.any() and not keep.all():
+            ts, td = es[~keep], ed[~keep]
+            o = np.argsort(td, kind="stable")   # chunk plans want dst-sorted
+            mm = build_aggregate_plans(ts[o], td[o], num_rows, table_rows)
+            es, ed = es[keep], ed[keep]
+    bwd_geom = pick(bwd_spec, ed, es, table_rows, num_rows)
+    if getattr(bwd_geom, "hub_minc", 0):
+        # the split happened (once) on the forward cells; the bwd binned
+        # plan covers exactly the transposed dense edges
+        bwd_geom = bwd_geom._replace(hub_minc=0)
     return BinnedPlans(
-        fwd=build_binned_plan(edge_src, edge_dst, num_rows, table_rows,
-                              geom=pick(fwd_spec, edge_src, edge_dst,
-                                        num_rows, table_rows)),
-        bwd=build_binned_plan(edge_dst, edge_src, table_rows, num_rows,
-                              geom=pick(bwd_spec, edge_dst, edge_src,
-                                        table_rows, num_rows)))
+        fwd=build_binned_plan(es, ed, num_rows, table_rows, geom=fwd_geom),
+        bwd=build_binned_plan(ed, es, table_rows, num_rows, geom=bwd_geom),
+        mm=mm)
 
 
 def matmul_precision(aggregate_precision: str) -> str:
@@ -377,6 +401,8 @@ def pad_binned_plans(plans: "list[BinnedPlans]", min_fwd=(0, 0),
     are equal across shards.  ``min_fwd``/``min_bwd`` are (C1, C2) floors
     — the per-host loader passes allgathered global maxima."""
     from roc_tpu.ops.pallas.binned import pad_binned_plan
+    assert all(b.mm is None for b in plans), \
+        "hybrid (binned+matmul) plans are single-device only"
 
     def stack(side, floors):
         ps = [getattr(b, side) for b in plans]
@@ -403,9 +429,17 @@ def scatter_gather_binned(x, plans: BinnedPlans, interpret: bool = False,
     fp32 staging + 3-way bf16 split dots — fp32-exact like the matmul
     backend, at the binned kernels' memory schedule (the round-3 answer
     to "the fp32-exact path loses to the reference figure").
-    Differentiable w.r.t. x."""
+    Differentiable w.r.t. x.
+
+    A hybrid plan (plans.mm set) adds the thin-cell edges' one-hot matmul
+    aggregation: A = A_dense + A_thin."""
     from roc_tpu.ops.pallas.binned import run_binned
-    return run_binned(x, plans.fwd, interpret, precision)
+    out = run_binned(x, plans.fwd, interpret, precision)
+    if plans.mm is not None:
+        out = out + _matmul_run(
+            x, plans.mm.fwd_obi, plans.mm.fwd_edst, plans.mm.fwd_esrc,
+            plans.fwd.num_rows, matmul_precision(precision))
+    return out
 
 
 def _bn_fwd(x, plans, interpret, precision):
@@ -415,6 +449,10 @@ def _bn_fwd(x, plans, interpret, precision):
 def _bn_bwd(interpret, precision, plans, g):
     from roc_tpu.ops.pallas.binned import run_binned
     gx = run_binned(g, plans.bwd, interpret, precision)
+    if plans.mm is not None:
+        gx = gx + _matmul_run(
+            g, plans.mm.bwd_obi, plans.mm.bwd_edst, plans.mm.bwd_esrc,
+            plans.bwd.num_rows, matmul_precision(precision))
     zero = jax.tree.map(
         lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
     return gx, zero

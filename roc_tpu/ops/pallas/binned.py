@@ -104,6 +104,19 @@ class Geometry(NamedTuple):
     slot: int     # staging write granularity, rows
     rb: int       # destination rows per bin (phase-2 resident window)
     ch2: int      # staging rows per phase-2 chunk
+    # Group-row target (0 = module default _GROUP_ROW_TARGET).  Part of the
+    # geometry because chunk counts depend on it: fewer groups mean less
+    # per-(group, block) chunk rounding in phase 1 (the products-shape
+    # chunk-count lever, tools/sweep_binned.py) at the cost of a larger
+    # staging buffer.
+    grt: int = 0
+    # Hub-split threshold (0 = pure binned): cells with fewer than
+    # `hub_minc` edges route to the one-hot matmul side of a hybrid plan
+    # (build_binned_plans).  Power-law graphs concentrate most edges into
+    # a few dense hub cells while the degree tail sprays thin cells whose
+    # slot padding dominates; the split keeps the binned kernels on the
+    # dense cells only.
+    hub_minc: int = 0
 
     @property
     def nslot(self) -> int:
@@ -112,6 +125,10 @@ class Geometry(NamedTuple):
     @property
     def slot2(self) -> int:
         return self.ch2 // self.slot
+
+    @property
+    def group_rows(self) -> int:
+        return self.grt or _GROUP_ROW_TARGET
 
     def check(self) -> "Geometry":
         assert self.sb >= 1 and self.rb >= 1, self
@@ -148,6 +165,23 @@ GEOM_SPARSE = Geometry(sb=1024, ch=2048, slot=16, rb=1024, ch2=2048)
 # where the occupancy stats say every smaller window drowns in slot
 # padding, which is exactly what the cost model weighs.
 GEOM_XSPARSE = Geometry(sb=2048, ch=1024, slot=16, rb=2048, ch2=1024)
+
+# Wide-chunk variants — the products-shape chunk-count lever (CPU sweep,
+# 2026-08-04, tools/sweep_binned.py + BASELINE.md round-5 notes): at the
+# 2.45M-node products shape the per-(group, block) chunk rounding and the
+# per-grid-step overhead dominate both phases, so doubling the chunk sizes
+# and quadrupling the group-row target (fewer groups = fewer rounded
+# streams) cuts phase-1 steps ~50% (16512 -> 8208 at CH=4096 + grt=1<<23)
+# and phase-2 steps ~49% (7692 -> 3891 at CH2=8192), modeled 310 -> 257 ms
+# per aggregation.  VMEM doubles with the chunks, so these only fit
+# H <= 256 with bf16 staging ("fast" precision) — _vmem_bytes gates them
+# out of choose_geometry's candidate list beyond that.
+GEOM_WIDE = Geometry(sb=512, ch=4096, slot=128, rb=512, ch2=8192,
+                     grt=1 << 23)
+GEOM_MID_WIDE = Geometry(sb=512, ch=4096, slot=32, rb=512, ch2=8192,
+                         grt=1 << 23)
+GEOM_SPARSE_WIDE = Geometry(sb=1024, ch=4096, slot=16, rb=1024, ch2=4096,
+                            grt=1 << 23)
 
 # Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).  Fewer
 # groups = less per-(group, block) chunk-rounding padding in phase 1 at the
@@ -198,7 +232,9 @@ def _pad_to(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def binned_viable(num_rows: int, table_rows: int, num_edges: int) -> bool:
+def binned_viable(num_rows: int, table_rows: int, num_edges: int,
+                  edge_src: np.ndarray = None,
+                  edge_dst: np.ndarray = None) -> bool:
     """Is the binned schedule padding-tolerable for this graph?
 
     Cells are (source-block x bin) pairs and every non-empty cell pads to
@@ -210,7 +246,15 @@ def binned_viable(num_rows: int, table_rows: int, num_edges: int) -> bool:
     the one-hot matmul backend is the right fast path instead.  Threshold:
     average cell >= SLOT*4/5 = 102.4 edges — slightly tighter than the
     round-2 3*SLOT(=32) rule's >= 96; graphs averaging 96-102 edges/cell
-    now take the matmul backend instead."""
+    now take the matmul backend instead.
+
+    With edge arrays the call defers to :func:`choose_geometry`'s
+    measured-statistics policy (including the sparse presets and the hub
+    hybrid) instead of the uniform-occupancy bound — a skewed or
+    locality-ordered graph is credited for the cells it never touches."""
+    if edge_src is not None:
+        g, _ = choose_geometry(edge_src, edge_dst, num_rows, table_rows)
+        return g is not None
     num_bins = max(-(-num_rows // RB), 1)
     num_blocks = max(-(-table_rows // SB), 1)
     return num_blocks * num_bins * SLOT * 4 <= num_edges * 5
@@ -230,27 +274,73 @@ def binned_viable(num_rows: int, table_rows: int, num_edges: int) -> bool:
 _MXU_EFF_FLOPS = 69e12        # 35% of v5e bf16 peak (phase-1 measured)
 _CHUNK_OVERHEAD_S = 11e-6     # per grid step (9.6-12.2 us measured)
 _SLOT_DMA_S = 31e-9           # per staging slot DMA (SLOT sweep delta)
-_MATMUL_NS_PER_EDGE = 15.0
+# Matmul backend: per-chunk cost of the one-hot scan (gather EB rows +
+# S1/S2 dots + DUS).  Re-fit 2026-08-04 from the round-2 Reddit point
+# (23.5M edges -> 351 ms) against the REAL chunk count — ceil(E/EB) edge
+# chunks PLUS the ceil(rows/VB) per-window >=1-chunk floor
+# (segment_sum.build_chunk_plan) that the old flat 15 ns/edge model
+# ignored.  That floor is exactly what inflates the matmul backend at
+# products shape: 306k windows for 2.45M rows regardless of density.
+_MM_CHUNK_S = 2.9e-6
 _MODEL_H = 256                # nominal width: plans are H-independent
+# VMEM feasibility for choose_geometry's candidates, at the nominal model
+# width and bf16 staging (the "fast" precision the hardware path runs):
+# phase 1 holds the ch x sb one-hot, double gbuf, and an sb x H x block;
+# phase 2 the ch2 x rb one-hot, a ch2 x H staging chunk, and the fp32
+# rb x H resident window.  ~16 MB/core on v5e; leave headroom.
+_VMEM_BUDGET = 14 * (1 << 20)
+
+
+def _matmul_chunks(num_edges: int, num_rows: int) -> int:
+    """Chunk count of the one-hot matmul backend for this shape: edges
+    pack EB per chunk, but every VB-row output window costs at least one
+    chunk (the obi>=1 invariant, segment_sum.build_chunk_plan)."""
+    from roc_tpu.ops.pallas.segment_sum import EB, VB
+    return -(-num_edges // EB) + -(-num_rows // VB)
+
+
+def _matmul_cost(num_edges: int, num_rows: int) -> float:
+    return _matmul_chunks(num_edges, num_rows) * _MM_CHUNK_S
+
+
+def _vmem_bytes(geom: Geometry, H: int = _MODEL_H,
+                exact: bool = False) -> int:
+    stg = 4 if exact else 2
+    p1 = (geom.ch * geom.sb * 2 + 2 * geom.ch * H * stg
+          + geom.sb * H * 4)
+    p2 = (geom.ch2 * geom.rb * 2 + geom.ch2 * H * stg
+          + geom.rb * H * 4)
+    return max(p1, p2)
 
 
 def _binned_cost_model(padded_rows: int, geom: Geometry,
-                       H: int = _MODEL_H) -> float:
+                       H: int = _MODEL_H, steps1: int = None,
+                       steps2: int = None) -> float:
     """Modeled seconds for ONE aggregation pass at this geometry, given the
-    actual slot-padded staging row count (from cell statistics)."""
-    mac1 = padded_rows * geom.sb * H * 2 / _MXU_EFF_FLOPS
-    mac2 = padded_rows * geom.rb * H * 2 / _MXU_EFF_FLOPS
-    ov1 = padded_rows / geom.ch * _CHUNK_OVERHEAD_S
-    ov2 = padded_rows / geom.ch2 * _CHUNK_OVERHEAD_S
+    actual slot-padded staging row count (from cell statistics).
+
+    With ``steps1``/``steps2`` (exact grid step counts, _plan_steps) the
+    MAC and per-step-overhead terms price the REAL schedule — including
+    per-(group, block) chunk rounding and per-group max-padding, the
+    effects the wide-chunk presets exist to shrink.  Without them the
+    model falls back to the ideal padded_rows/chunk approximation."""
+    rows1 = steps1 * geom.ch if steps1 is not None else padded_rows
+    rows2 = steps2 * geom.ch2 if steps2 is not None else padded_rows
+    mac1 = rows1 * geom.sb * H * 2 / _MXU_EFF_FLOPS
+    mac2 = rows2 * geom.rb * H * 2 / _MXU_EFF_FLOPS
+    ov1 = (steps1 if steps1 is not None
+           else padded_rows / geom.ch) * _CHUNK_OVERHEAD_S
+    ov2 = (steps2 if steps2 is not None
+           else padded_rows / geom.ch2) * _CHUNK_OVERHEAD_S
     dma1 = padded_rows / geom.slot * _SLOT_DMA_S
     return max(mac1, ov1) + dma1 + max(mac2, ov2)
 
 
-def _cell_counts(edge_src: np.ndarray, edge_dst: np.ndarray,
-                 sb: int, rb: int) -> np.ndarray:
-    """Nonzero (source-block x destination-bin) cell occupancies — one
-    O(E) bincount, the single implementation every occupancy consumer
-    shares (cell key = block * nbins + bin)."""
+def _cell_stats(edge_src: np.ndarray, edge_dst: np.ndarray,
+                sb: int, rb: int):
+    """Nonzero (source-block x destination-bin) cells: returns
+    (cell_blk, cell_bin, cnt) int64 arrays — one O(E) bincount, the single
+    implementation every occupancy consumer shares."""
     blk = np.asarray(edge_src, np.int64) // sb
     bn = np.asarray(edge_dst, np.int64) // rb
     nbins = int(bn.max(initial=0)) + 1
@@ -259,13 +349,57 @@ def _cell_counts(edge_src: np.ndarray, edge_dst: np.ndarray,
     if nkeys <= max(4 * len(keys), 1 << 20):
         # dense O(E + cells) bincount while the cell table is small
         cnt = np.bincount(keys, minlength=0)
-        return cnt[cnt > 0]
-    # Sparse O(E log E) time / O(E) memory fallback: a dense bincount is
-    # O(blocks*bins) memory regardless of occupancy — ~376 GB at papers100M
-    # scale with sb=rb=512, which would OOM exactly the offline
-    # preprocessing paths (-reorder auto, convert --reorder) advertised
-    # for such graphs.
-    return np.unique(keys, return_counts=True)[1]
+        uniq = np.flatnonzero(cnt)
+        cnt = cnt[uniq]
+    else:
+        # Sparse O(E log E) time / O(E) memory fallback: a dense bincount
+        # is O(blocks*bins) memory regardless of occupancy — ~376 GB at
+        # papers100M scale with sb=rb=512, which would OOM exactly the
+        # offline preprocessing paths (-reorder auto, convert --reorder)
+        # advertised for such graphs.
+        uniq, cnt = np.unique(keys, return_counts=True)
+    return uniq // nbins, uniq % nbins, cnt.astype(np.int64)
+
+
+def _cell_counts(edge_src: np.ndarray, edge_dst: np.ndarray,
+                 sb: int, rb: int) -> np.ndarray:
+    """Nonzero cell occupancies only (see _cell_stats)."""
+    return _cell_stats(edge_src, edge_dst, sb, rb)[2]
+
+
+def _plan_steps(cell_blk: np.ndarray, cell_bin: np.ndarray,
+                cnt: np.ndarray, geom: Geometry, num_rows: int,
+                table_rows: int, num_edges: int):
+    """Exact (padded_rows, phase-1 steps, phase-2 steps) the plan builder
+    would produce for these cells — same arithmetic as
+    _build_binned_plan_numpy, O(cells).  Steps are G*C1 / G*C2: every
+    group runs the per-group MAXIMUM chunk count (one stacked static
+    program), so group-count and rounding effects are priced, which is
+    what makes the chunk-count lever visible to the cost model."""
+    num_bins = max(-(-num_rows // geom.rb), 1)
+    num_blocks = max(-(-table_rows // geom.sb), 1)
+    bpg = max(min(num_bins,
+                  int(geom.group_rows / max(num_edges / num_bins, 1)),
+                  _K2_CAP // num_blocks), 1)
+    G = -(-num_bins // bpg)
+    cell_slots = -(-cnt // geom.slot)
+    padded = int(cell_slots.sum() * geom.slot)
+    # phase 1: chunks per (group, block) stream, per-group sums, max
+    gb = (cell_bin // bpg) * num_blocks + cell_blk
+    gb_uniq, gb_inv = np.unique(gb, return_inverse=True)
+    gb_slots = np.bincount(gb_inv, weights=cell_slots).astype(np.int64)
+    gb_chunks = -(-gb_slots // geom.nslot)
+    c1_per_g = np.bincount((gb_uniq // num_blocks).astype(np.int64),
+                           weights=gb_chunks, minlength=G)
+    C1 = _pad_to(max(int(c1_per_g.max(initial=0)), 1), 8)
+    # phase 2: chunks per bin (empty bins still cost one), per-group max
+    bin_slots = np.bincount(cell_bin, weights=cell_slots,
+                            minlength=num_bins).astype(np.int64)
+    bin_chunks = np.maximum(-(-bin_slots // geom.slot2), 1)
+    c2_per_g = np.bincount(np.arange(num_bins) // bpg, weights=bin_chunks,
+                           minlength=G)
+    C2 = max(int(c2_per_g.max(initial=0)), 1)
+    return padded, G * C1, G * C2
 
 
 def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,
@@ -287,6 +421,14 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     item 3: products-density graphs get a measured-stats policy instead of
     the uniform-occupancy rejection).
 
+    Degree-aware: every candidate is priced at its EXACT schedule shape
+    (_plan_steps over the actual cell statistics, so skew and grouping
+    effects count) and additionally as a HYBRID — cells under half a slot
+    (the padding-dominated tail of a power-law degree distribution) priced
+    on the one-hot matmul side instead, the dense hub cells staying
+    binned.  A hybrid winner is returned with ``hub_minc`` set on the
+    geometry; build_binned_plans splits the edge list accordingly.
+
     Returns (geom, modeled_seconds), with geom None when matmul wins (and
     the seconds then model matmul).  ``force=True`` always returns the best
     binned candidate — the explicit `-aggr-backend binned` path, where
@@ -296,25 +438,60 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     if E == 0:
         return None, 0.0
     cands = list(candidates) if candidates is not None else \
-        [_default_geom(), GEOM_MID, GEOM_SPARSE, GEOM_XSPARSE]
+        [_default_geom(), GEOM_WIDE, GEOM_MID, GEOM_MID_WIDE,
+         GEOM_SPARSE, GEOM_SPARSE_WIDE, GEOM_XSPARSE]
     best, best_t = None, float("inf")
     stats_cache = {}
     for g in cands:
         g = g.check()
+        if _vmem_bytes(g) > _VMEM_BUDGET:
+            continue
         sk = (g.sb, g.rb)
         if sk not in stats_cache:
-            # occupancy histogram depends only on the window pair; slot
-            # variants reuse it
-            stats_cache[sk] = _cell_counts(edge_src, edge_dst, g.sb, g.rb)
-        cnt = stats_cache[sk]
-        padded = int((-(-cnt // g.slot)).sum() * g.slot)
-        t = _binned_cost_model(padded, g)
+            # occupancy statistics depend only on the window pair; slot
+            # and chunk variants reuse them
+            stats_cache[sk] = _cell_stats(edge_src, edge_dst, g.sb, g.rb)
+        cblk, cbin, cnt = stats_cache[sk]
+        padded, s1, s2 = _plan_steps(cblk, cbin, cnt, g, num_rows,
+                                     table_rows, E)
+        t = _binned_cost_model(padded, g, steps1=s1, steps2=s2)
         if t < best_t:
             best, best_t = g, t
-    t_matmul = E * _MATMUL_NS_PER_EDGE * 1e-9
-    if force or best_t < t_matmul:
+        # Hybrid variant: the sub-half-full cells' edges go to the matmul
+        # side (they pay its per-chunk rate but no slot padding); the
+        # matmul window floor is a fixed cost of having a matmul side at
+        # all.  Only worth modeling when a meaningful split exists.
+        minc = g.slot // 2
+        thin = cnt < minc
+        E_thin = int(cnt[thin].sum())
+        if 0 < E_thin < E:
+            keep = ~thin
+            padded_d, s1_d, s2_d = _plan_steps(
+                cblk[keep], cbin[keep], cnt[keep], g, num_rows,
+                table_rows, E - E_thin)
+            t_h = (_binned_cost_model(padded_d, g, steps1=s1_d,
+                                      steps2=s2_d)
+                   + _matmul_cost(E_thin, num_rows))
+            if t_h < best_t:
+                best, best_t = g._replace(hub_minc=minc), t_h
+    t_matmul = _matmul_cost(E, num_rows)
+    if force or (best is not None and best_t < t_matmul):
         return best, best_t
     return None, t_matmul
+
+
+def split_hub_edges(edge_src: np.ndarray, edge_dst: np.ndarray,
+                    geom: Geometry):
+    """Partition edges for the hybrid plan: a boolean mask that is True
+    for edges in (source-block x destination-bin) cells with at least
+    ``geom.hub_minc`` edges (the dense hub cells that stay binned);
+    False edges take the one-hot matmul side."""
+    blk = np.asarray(edge_src, np.int64) // geom.sb
+    bn = np.asarray(edge_dst, np.int64) // geom.rb
+    nbins = int(bn.max(initial=0)) + 1
+    keys = blk * nbins + bn
+    _, inv, cnt = np.unique(keys, return_inverse=True, return_counts=True)
+    return cnt[inv] >= geom.hub_minc
 
 
 def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
@@ -337,16 +514,29 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     (O(E), ~14x the NumPy lexsort path: 2.0 s vs 27.3 s at Reddit scale,
     docs/PERF.md); the vectorized
     NumPy fallback below is the correctness oracle
-    (tests/test_binned.py::test_native_plan_equals_numpy)."""
+    (tests/test_binned.py::test_native_plan_equals_numpy).
+
+    At 100M-edge scale even the native build is minutes of host work per
+    direction, so built plans are cached on disk keyed by the edge-list
+    content and the full schedule-shaping input (geometry incl. group
+    target, shape) — see _plan_cache_path."""
     from roc_tpu import native
     geom = (geom or _default_geom()).check()
+    if geom.grt:
+        group_row_target = geom.grt
+    cache = _plan_cache_path(edge_src, edge_dst, num_rows, table_rows,
+                             group_row_target, geom)
+    if cache is not None and os.path.exists(cache):
+        plan = _plan_cache_load(cache, num_rows, table_rows, geom)
+        if plan is not None:
+            return plan
     if len(edge_src) >= (1 << 20) and native.available():
         (p1_srcl, p1_off, p1_blk, p2_dstl, p2_obi, p2_first,
          bpg) = native.binned_plan(edge_src, edge_dst, num_rows, table_rows,
                                    group_row_target, geom)
         G, C1 = p1_blk.shape
         C2 = p2_obi.shape[1]
-        return BinnedPlan(
+        plan = BinnedPlan(
             p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * geom.ch, 1)),
             p1_off=jnp.asarray(p1_off),
             p1_blk=jnp.asarray(p1_blk),
@@ -355,8 +545,88 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
             p2_first=jnp.asarray(p2_first),
             num_rows=num_rows, table_rows=table_rows, bins_per_group=bpg,
             geom=geom)
-    return _build_binned_plan_numpy(edge_src, edge_dst, num_rows,
-                                    table_rows, group_row_target, geom)
+    else:
+        plan = _build_binned_plan_numpy(edge_src, edge_dst, num_rows,
+                                        table_rows, group_row_target, geom)
+    if cache is not None:
+        _plan_cache_save(cache, plan)
+    return plan
+
+
+def _plan_cache_dir() -> str:
+    """Plan cache location; '' disables.  ROC_PLAN_CACHE=0 opts out,
+    ROC_PLAN_CACHE_DIR overrides (tests point it at tmp dirs)."""
+    if os.environ.get("ROC_PLAN_CACHE", "1") == "0":
+        return ""
+    return os.environ.get(
+        "ROC_PLAN_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     f"roc_plans_u{os.getuid()}"))
+
+
+def _plan_cache_path(edge_src, edge_dst, num_rows, table_rows,
+                     group_row_target, geom):
+    """Content-keyed cache file for one built plan, or None when caching
+    is off or the graph is below the worth-it threshold (hashing is O(E)
+    but cheap — ~1 s/GB — next to the minutes-long 100M-edge build)."""
+    min_edges = int(os.environ.get("ROC_PLAN_CACHE_MIN_EDGES", 1 << 24))
+    base = _plan_cache_dir()
+    if not base or len(edge_src) < min_edges:
+        return None
+    import hashlib
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(edge_src, np.int64).tobytes())
+    h.update(np.ascontiguousarray(edge_dst, np.int64).tobytes())
+    h.update(repr(("v1", num_rows, table_rows, group_row_target,
+                   tuple(geom))).encode())
+    return os.path.join(base, f"binned_plan_{h.hexdigest()}.npz")
+
+
+def _plan_cache_load(path, num_rows, table_rows, geom):
+    """Best-effort load; None on any mismatch/corruption (rebuilds)."""
+    try:
+        with np.load(path) as z:
+            meta = z["meta"]
+            if (int(meta[0]) != num_rows or int(meta[1]) != table_rows
+                    or tuple(int(v) for v in z["geom"]) != tuple(geom)):
+                return None
+            G = z["p1_blk"].shape[0]
+            C1 = z["p1_blk"].shape[1]
+            C2 = z["p2_obi"].shape[1]
+            return BinnedPlan(
+                p1_srcl=jnp.asarray(z["p1_srcl"].reshape(
+                    G, C1 * geom.ch, 1)),
+                p1_off=jnp.asarray(z["p1_off"]),
+                p1_blk=jnp.asarray(z["p1_blk"]),
+                p2_dstl=jnp.asarray(z["p2_dstl"].reshape(
+                    G, C2 * geom.ch2, 1)),
+                p2_obi=jnp.asarray(z["p2_obi"]),
+                p2_first=jnp.asarray(z["p2_first"]),
+                num_rows=num_rows, table_rows=table_rows,
+                bins_per_group=int(meta[2]), geom=geom)
+    except Exception:
+        return None
+
+
+def _plan_cache_save(path, plan: BinnedPlan) -> None:
+    """Best-effort atomic save (tmp + rename); failures never propagate."""
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".{os.getpid()}.tmp.npz"   # savez keeps .npz as-is
+        G = plan.p1_blk.shape[0]
+        np.savez(tmp,
+                 p1_srcl=np.asarray(plan.p1_srcl).reshape(G, -1),
+                 p1_off=np.asarray(plan.p1_off),
+                 p1_blk=np.asarray(plan.p1_blk),
+                 p2_dstl=np.asarray(plan.p2_dstl).reshape(G, -1),
+                 p2_obi=np.asarray(plan.p2_obi),
+                 p2_first=np.asarray(plan.p2_first),
+                 meta=np.asarray([plan.num_rows, plan.table_rows,
+                                  plan.bins_per_group], np.int64),
+                 geom=np.asarray(tuple(plan.geom), np.int64))
+        os.replace(tmp, path)
+    except Exception:
+        pass
 
 
 def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
@@ -365,7 +635,9 @@ def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
                              geom: Geometry = None) -> BinnedPlan:
     """The oracle plan builder (vectorized NumPy lexsort + prefix sums)."""
     geom = (geom or _default_geom()).check()
-    SB, CH, SLOT, RB, CH2 = geom          # noqa: N806 — shadow the module
+    if geom.grt:
+        group_row_target = geom.grt
+    SB, CH, SLOT, RB, CH2 = geom[:5]      # noqa: N806 — shadow the module
     NSLOT, SLOT2 = geom.nslot, geom.slot2   # constants with plan geometry
     edge_src = np.asarray(edge_src, np.int64)
     edge_dst = np.asarray(edge_dst, np.int64)

@@ -1514,8 +1514,13 @@ class SpmdTrainer(BaseTrainer):
         exchange = self._exchange_mode
         optimizer = self.optimizer
         k = self.k
-        # pallas_call can't annotate vma yet; the matmul backend is plain XLA
-        check_vma = gd.plans is None or gd.backend == "matmul"
+        # pallas_call can't annotate vma yet; the matmul backend is plain
+        # XLA.  Binned pallas plans can live in `plans` (fused exchange) OR
+        # in the halo-overlap split pair `plans_local`/`plans_remote` —
+        # any of them present means pallas_call traces inside shard_map.
+        has_plans = (gd.plans is not None or gd.plans_local is not None
+                     or gd.plans_remote is not None)
+        check_vma = (not has_plans) or gd.backend == "matmul"
 
         def block_gctx(gd_block):
             """Per-device GraphCtx: one part (squeezed) or k stacked."""

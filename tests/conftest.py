@@ -23,10 +23,18 @@ _flags.append("--xla_force_host_platform_device_count=8")
 # skew is routine, and the giant scale-guard programs aborted
 # intermittently (~50%) until these were raised.  Pre-set values win
 # (only appended when absent), so an operator can still tighten them.
-for _d in ("--xla_cpu_collective_call_terminate_timeout_seconds=1200",
-           "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120"):
-    if not any(f.startswith(_d.split("=")[0]) for f in _flags):
-        _flags.append(_d)
+# These flags landed with jaxlib 0.5-era XLA; an older XLA hard-aborts
+# ("Unknown flags in XLA_FLAGS") on ANY unrecognized flag, so gate them.
+try:
+    import jaxlib.version as _jlv
+    _jaxlib_v = tuple(int(p) for p in _jlv.__version__.split(".")[:2])
+except Exception:  # pragma: no cover - be permissive about version layout
+    _jaxlib_v = (0, 0)
+if _jaxlib_v >= (0, 5):
+    for _d in ("--xla_cpu_collective_call_terminate_timeout_seconds=1200",
+               "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120"):
+        if not any(f.startswith(_d.split("=")[0]) for f in _flags):
+            _flags.append(_d)
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import jax  # noqa: E402
@@ -36,7 +44,12 @@ jax.config.update("jax_platforms", "cpu")
 # for its own backend quirks; that also disables the shard_map custom-vjp
 # vma typecheck and once let a bwd-rule bug pass CI while failing in every
 # clean environment.  Tests must run strict.
-jax.config.update("jax_disable_bwd_checks", False)
+try:
+    jax.config.update("jax_disable_bwd_checks", False)
+except AttributeError:
+    # jax < 0.5 has no bwd checks (nor the vma machinery they verify) —
+    # nothing to re-enable; roc_tpu._jax_compat polyfills the rest.
+    pass
 try:
     from jax._src import xla_bridge
 
@@ -66,6 +79,7 @@ if not os.environ.get("ROC_TEST_NO_COMPILE_CACHE"):
     except Exception:
         pass
 
+import roc_tpu  # noqa: E402, F401  (installs jax 0.4.x polyfills)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -178,3 +178,58 @@ def test_fast_precision_plumbs_through():
         assert tr.gdata.precision == prec
         losses[prec] = [float(tr.run_epoch()) for _ in range(2)]
     np.testing.assert_allclose(losses["fast"], losses["exact"], rtol=5e-3)
+
+def test_forced_matmul_identical_to_auto(monkeypatch):
+    """Round-5 forced-vs-auto anomaly root cause (docs/PERF.md): with auto
+    resolving to matmul, the forced `-aggr-backend matmul` trainer lowers
+    to a BYTE-IDENTICAL train-step program — the measured 8.5x gap
+    (256.2 s vs 30.1 s/epoch at the products shape) was cross-invocation
+    harness state, not a program difference.  Pinned so a resolution
+    change that introduces a real divergence fails loudly; same-process
+    steady-state epoch times must also stay within 1.2x.  The hardware
+    reproduction of the A/B is one flag:
+      ROC_BENCH_SHAPE=products ROC_BENCH_AB=matmul,auto python bench.py
+    """
+    import hashlib
+    import time
+
+    import roc_tpu.train.driver as drv
+
+    # auto must resolve to matmul on CPU: drop the TPU gate + edge floor,
+    # and keep binned out of the race
+    monkeypatch.setattr(drv, "AUTO_MATMUL_EDGES", 1)
+    monkeypatch.setattr(drv, "AUTO_BINNED", False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    ds, _, _ = graph_and_x(n=600)
+    base = dict(layers=[8, 16, 4], num_epochs=1, dropout_rate=0.0,
+                eval_every=10**9)
+    tf = Trainer(Config(**base, aggregate_backend="matmul"), ds,
+                 build_gcn(base["layers"], 0.0))
+    ta = Trainer(Config(**base, aggregate_backend="auto"), ds,
+                 build_gcn(base["layers"], 0.0))
+    assert tf.gdata.backend == ta.gdata.backend == "matmul"
+
+    def step_text(tr):
+        return tr._train_step.lower(
+            tr.params, tr.opt_state, tr.x, tr.labels, tr.mask, tr.gdata,
+            jax.random.key(0), jnp.float32(0.01)).as_text()
+
+    hf = hashlib.sha1(step_text(tf).encode()).hexdigest()
+    ha = hashlib.sha1(step_text(ta).encode()).hexdigest()
+    assert hf == ha, "forced and auto-resolved matmul lower differently"
+
+    # steady-state parity, same process (bench.py ROC_BENCH_AB's logic in
+    # miniature): median over several post-compile epochs
+    def median_epoch_s(tr, k=10):
+        tr.run_epoch()                       # compile epoch, not measured
+        drv.device_sync(tr.params)
+        times = []
+        for _ in range(k):
+            t0 = time.perf_counter()
+            drv.device_sync(tr.run_epoch())
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[k // 2]
+
+    mf, ma = median_epoch_s(tf), median_epoch_s(ta)
+    ratio = max(mf, ma) / min(mf, ma)
+    assert ratio < 1.2, (mf, ma, ratio)

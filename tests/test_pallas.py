@@ -114,9 +114,13 @@ def test_training_pallas_equals_xla_single_device():
     for i in range(3):
         lx, lp = float(tx.run_epoch()), float(tp.run_epoch())
         np.testing.assert_allclose(lp, lx, rtol=5e-3, err_msg=f"epoch {i}")
+    # atol floors the comparison for near-zero params: after 3 Adam steps
+    # the bf16 rounding noise accumulates to a few 1e-4 absolute on
+    # elements of ~1e-4 magnitude (the exact rounding differs per jax
+    # version's interpret mode), where rtol is meaningless.
     np.testing.assert_allclose(
         np.asarray(tp.params["linear_0"]), np.asarray(tx.params["linear_0"]),
-        rtol=5e-3, atol=1e-4)
+        rtol=5e-3, atol=5e-4)
 
 
 @pytest.mark.parametrize("halo", [False, True])

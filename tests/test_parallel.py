@@ -447,52 +447,64 @@ def test_halo_overlap_local_dots_independent_of_collective():
     import jax.numpy as jnp
 
     def wrapped(x, gd_arrays):
-        gd_block = jax.tree.util.tree_unflatten(gd_treedef, gd_arrays)
+        gd_block = jax.tree_util.tree_unflatten(gd_treedef, gd_arrays)
         return one_shard_aggregate(x, gd_block)
 
     gd_one = jax.tree.map(lambda a: a[0], gd)   # squeeze the parts axis
-    gd_arrays, gd_treedef = jax.tree.util.tree_flatten(gd_one)
-    with jax.sharding.Mesh(np.array(jax.devices()[:4]), ("parts",)):
-        jaxpr = jax.make_jaxpr(
-            lambda x, arrs: jax.shard_map(
-                lambda x_, *a: wrapped(x_, list(a)),
-                in_specs=(jax.sharding.PartitionSpec(),) * (1 + len(gd_arrays)),
-                out_specs=jax.sharding.PartitionSpec(),
-                check_vma=False,
-            )(x_=x, *arrs) if False else wrapped(x, arrs)
-        )(x, gd_arrays)
+    gd_arrays, gd_treedef = jax.tree_util.tree_flatten(gd_one)
+    # trace THROUGH shard_map so all_to_all sees a bound axis name — the
+    # aggregation body alone would fail to trace its collective
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("parts",))
+    Pspec = jax.sharding.PartitionSpec
+    sm = jax.shard_map(
+        lambda x_, *a: wrapped(x_, list(a)),
+        mesh=mesh,
+        in_specs=(Pspec(),) * (1 + len(gd_arrays)),
+        out_specs=Pspec(),
+        check_vma=False,
+    )
+    jaxpr = jax.make_jaxpr(lambda x, arrs: sm(x, *arrs))(x, gd_arrays)
 
-    # walk the jaxpr (including sub-jaxprs) flattening to a linear eqn list
-    eqns = []
+    # Taint-walk the jaxpr, following taint through sub-jaxpr call
+    # boundaries (shard_map body, pjit, the matmul backend's lax.scan):
+    # an eqn's tainted invars map positionally onto its sub-jaxpr's
+    # invars, and a sub-jaxpr with tainted outvars taints the eqn.
+    from jax.core import Literal
 
-    def collect(jx):
+    saw = {"a2a": False, "clean": False, "tainted": False}
+
+    def run(jx, tainted_in):
+        tainted = set(tainted_in)
         for e in jx.eqns:
-            eqns.append(e)
+            ein = [v for v in e.invars if not isinstance(v, Literal)]
+            is_tainted = any(v in tainted for v in ein)
+            if "all_to_all" in e.primitive.name:
+                saw["a2a"] = True
+                is_tainted = True
+            subs = []
             for v in e.params.values():
-                if hasattr(v, "jaxpr"):
-                    collect(v.jaxpr)
-                if isinstance(v, (list, tuple)):
-                    for vv in v:
-                        if hasattr(vv, "jaxpr"):
-                            collect(vv.jaxpr)
-    collect(jaxpr.jaxpr)
+                for vv in (v if isinstance(v, (list, tuple)) else (v,)):
+                    if hasattr(vv, "jaxpr") and hasattr(vv.jaxpr, "eqns"):
+                        subs.append(vv.jaxpr)   # ClosedJaxpr
+                    elif hasattr(vv, "eqns"):
+                        subs.append(vv)         # open Jaxpr (shard_map)
+            sub_out_tainted = False
+            for sj in subs:
+                if len(sj.invars) == len(ein):
+                    tin = {sv for sv, ov in zip(sj.invars, ein)
+                           if ov in tainted}
+                else:   # conservative: arity mismatch, taint all or none
+                    tin = set(sj.invars) if is_tainted else set()
+                if run(sj, tin):
+                    sub_out_tainted = True
+            if is_tainted or sub_out_tainted:
+                tainted.update(e.outvars)
+            if e.primitive.name == "dot_general":
+                saw["tainted" if is_tainted else "clean"] = True
+        return any(v in tainted for v in jx.outvars)
 
-    tainted = set()
-    saw_a2a = saw_clean_dot = saw_tainted_dot = False
-    for e in eqns:
-        invars = [str(v) for v in e.invars if hasattr(v, "aval")]
-        is_tainted = any(v in tainted for v in invars)
-        if "all_to_all" in e.primitive.name:
-            saw_a2a = True
-            is_tainted = True
-        if is_tainted:
-            tainted.update(str(v) for v in e.outvars)
-        if e.primitive.name == "dot_general":
-            if is_tainted:
-                saw_tainted_dot = True
-            else:
-                saw_clean_dot = True
-    assert saw_a2a, "no all_to_all in the overlap aggregation"
-    assert saw_clean_dot, ("every dot_general depends on the collective — "
-                           "the local aggregation cannot overlap it")
-    assert saw_tainted_dot, "no dot consumes the halo rows (remote fold lost)"
+    run(jaxpr.jaxpr, set())
+    assert saw["a2a"], "no all_to_all in the overlap aggregation"
+    assert saw["clean"], ("every dot_general depends on the collective — "
+                          "the local aggregation cannot overlap it")
+    assert saw["tainted"], "no dot consumes the halo rows (remote fold lost)"

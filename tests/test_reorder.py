@@ -74,7 +74,13 @@ def test_rcm_restores_cell_locality():
     pad_after = B.padded_rows_for(srcp, dstp, B.GEOM_MID)
 
     assert pad_after < pad_before / 2, (pad_before, pad_after)
-    assert geom_before is None, (geom_before, t_before)
+    # Round 5 refit: the cost model now prices matmul's per-VB-window
+    # floor, so even the id-shuffled graph gets a (dust-absorbing) sparse
+    # binned geometry rather than None/matmul.  The reorder win is now
+    # expressed as modeled time, not a backend flip: RCM must collapse the
+    # padding enough that the chosen geometry gets strictly cheaper.
+    assert geom_before is not None, t_before
+    assert t_before < B._matmul_cost(len(src), n), t_before
     assert geom_after is not None and t_after < t_before, \
         (geom_after, t_after, t_before)
 

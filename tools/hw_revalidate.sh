@@ -36,12 +36,15 @@ fi
 if [ "$START" -le 2 ]; then
 note "2. products-shape single-chip A/B (the north-star graph:"
 note "   matmul vs binned-auto-geometry vs +RCM-reorder)"
-PROD="env ROC_BENCH_SHAPE=products ROC_BENCH_NODES=2449029 ROC_BENCH_DEG=51"
-PROD="$PROD ROC_BENCH_LAYERS=100-256-47 ROC_BENCH_EPOCHS=5"
-for be in matmul auto; do
-    $PROD ROC_BENCH_BACKEND=$be timeout 3000 python bench.py 2>&1 \
-        | tail -2 | tee -a "$LOG"
-done
+# ROC_BENCH_SHAPE=products now presets nodes/degree/layers by itself
+PROD="env ROC_BENCH_SHAPE=products ROC_BENCH_EPOCHS=5"
+# SAME-PROCESS A/B (round-5 anomaly fix, docs/PERF.md): both legs in one
+# invocation, per-epoch samples in the artifact — separate invocations
+# are how the 8.5x forced-vs-auto artifact happened.  With the refit
+# cost model auto now resolves to a sparse binned preset here, so the
+# legs are the real matmul-vs-binned comparison.
+$PROD ROC_BENCH_AB=matmul,auto timeout 6000 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
 # with the RCM locality pass (auto keeps the order only on a measured
 # padded-row gain): choose_geometry should then pick a binned geometry
 $PROD ROC_BENCH_BACKEND=auto ROC_BENCH_REORDER=auto timeout 3000 \

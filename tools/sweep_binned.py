@@ -38,7 +38,9 @@ CHILD_TIMEOUT_S = int(os.environ.get("SWEEP_TIMEOUT_S", 600))
 # forgets this mirror fails CI instead of measuring stale tuples.
 CONFIGS_PRODUCTS = [
     (512, 2048, 32, 512, 4096, 1 << 21),     # GEOM_MID
+    (512, 4096, 32, 512, 8192, 1 << 23),     # GEOM_MID_WIDE
     (1024, 2048, 16, 1024, 2048, 1 << 21),   # GEOM_SPARSE
+    (1024, 4096, 16, 1024, 4096, 1 << 23),   # GEOM_SPARSE_WIDE
     (2048, 1024, 16, 2048, 1024, 1 << 21),   # GEOM_XSPARSE
 ]
 
