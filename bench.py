@@ -127,6 +127,13 @@ INTER = os.environ.get("ROC_BENCH_INTER", "uniform")
 # the slowest/fastest leg ratio (unit "x", 1.0 = parity).
 AB = [s.strip() for s in os.environ.get("ROC_BENCH_AB", "").split(",")
       if s.strip()]
+# ROC_BENCH_BALANCE_EVERY=N: run the online cost-model load balancer
+# (roc_tpu/balance/) every N measured epochs; rebalance events + the latest
+# per-part probe timings land in the artifact.  Annotates the metric;
+# epoch_times stay pure epoch wall times (balance rounds run between the
+# timed epochs — see TrainStats), but the canonical vs_baseline claim
+# stays balance-off.
+BALANCE_EVERY = _env("ROC_BENCH_BALANCE_EVERY", "0", int)
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -141,7 +148,8 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + ("" if SCALE == 1.0 else f"_scale{SCALE:g}")
           + ("" if PRECISION == "fast" else f"_{PRECISION}")
           + ("" if REORDER == "off" else f"_reorder-{REORDER}")
-          + ("" if INTER == "uniform" else f"_inter-{INTER}"))
+          + ("" if INTER == "uniform" else f"_inter-{INTER}")
+          + ("" if BALANCE_EVERY == 0 else f"_balance{BALANCE_EVERY}"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -371,7 +379,8 @@ def run():
         cfg = Config(layers=LAYERS, num_epochs=1, learning_rate=0.01,
                      weight_decay=1e-4, dropout_rate=0.5, eval_every=10**9,
                      num_parts=n_dev, halo=True, aggregate_backend=backend,
-                     aggregate_precision=PRECISION, model=MODEL, heads=HEADS)
+                     aggregate_precision=PRECISION, model=MODEL, heads=HEADS,
+                     balance_every=BALANCE_EVERY)
         # aggr="": each model's own default (gcn sum, sage avg, ...) so the
         # metric name labels what actually ran
         model = build_model(MODEL, LAYERS, cfg.dropout_rate, "",
@@ -391,25 +400,24 @@ def run():
         return tr
 
     def measure(tr):
-        """Per-epoch wall times (host-synced each epoch).  The per-epoch
-        sync costs one device round trip (~ms against ~0.6 s epochs) and
-        buys the first-epoch-inflation visibility the round-5 anomaly
-        hunt needed — a wedged first invocation shows up as one outlier
-        sample instead of silently inflating the mean."""
+        """Measured epochs via the driver's own train() loop — TrainStats
+        is the single source of epoch timings (no bench-side re-derivation).
+        Each epoch is host-synced inside train(); that per-epoch sync costs
+        one device round trip (~ms against ~0.6 s epochs) and buys the
+        first-epoch-inflation visibility the round-5 anomaly hunt needed —
+        a wedged first invocation shows up as one outlier sample instead of
+        silently inflating the mean.  Balance rounds (if enabled) run
+        between the timed epochs, so epoch_times stay pure."""
         import gc
         gc.collect()               # no GC pause inside the measured loop
-        times = []
-        for _ in range(MEASURED):
-            t = time.perf_counter()
-            device_sync(tr.run_epoch())
-            times.append(time.perf_counter() - t)
-        return times
+        tr.config.num_epochs = MEASURED
+        return tr.train(print_fn=lambda *_: None)
 
     if AB:
         legs = {}
         for b in AB:
             tr = build_and_warm(b)
-            times = measure(tr)
+            times = measure(tr).epoch_times
             legs[b] = {
                 "value": round(sum(times) / len(times), 4),
                 "backend": tr.gdata.backend,
@@ -448,7 +456,8 @@ def run():
         fallback_from = type(e).__name__
     if fallback_from is not None:   # outside except: drop the failed
         trainer = build_and_warm(fb)         # trainer's HBM before rebuild
-    times = measure(trainer)
+    stats = measure(trainer)
+    times = stats.epoch_times
     epoch_s = sum(times) / len(times)
 
     edges_per_sec_per_chip = ds.graph.num_edges / epoch_s / n_dev
@@ -479,7 +488,8 @@ def run():
         # reorder-on ratio against the un-reordered reference figure would
         # mislead even though the metric name is annotated)
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
-        if MODEL == "gcn" and CANONICAL_SHAPE and REORDER == "off" else None,
+        if MODEL == "gcn" and CANONICAL_SHAPE and REORDER == "off"
+        and BALANCE_EVERY == 0 else None,
         "backend": resolved,                   # what auto resolved to
         "platform": jax.default_backend(),
         "edges_per_sec_per_chip": round(edges_per_sec_per_chip),
@@ -494,9 +504,20 @@ def run():
     }
     if fallback_from is not None:
         result["fallback"] = f"auto failed ({fallback_from}); ran {fb}"
+    if BALANCE_EVERY:
+        bal = {"events": stats.rebalance_events}
+        mgr = getattr(trainer, "balancer", None)
+        if mgr is not None:          # latest per-part probe timings
+            probes = mgr.telemetry.samples()
+            latest = probes[-trainer.config.num_parts:]
+            bal["part_probe_s"] = [round(s.time_s, 7) for s in latest]
+            bal["part_edges"] = [s.edges for s in latest]
+        else:                        # e.g. single device -> Trainer path
+            bal["note"] = "balancer unsupported for this trainer mode"
+        result["balance"] = bal
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
-            and CANONICAL_SHAPE and REORDER == "off"
+            and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

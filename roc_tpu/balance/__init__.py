@@ -1,0 +1,22 @@
+"""Online cost-model load balancer (the reference's partitioner loop).
+
+ROC's signature contribution is an *online* partitioner: a linear cost model
+fit to observed per-partition runtimes, driving a repartition search between
+training rounds.  This package closes the same loop for the TPU port:
+
+  telemetry.py   per-shard work counters + probe timings (ring buffer, JSONL)
+  cost_model.py  least-squares fit t_p ~ w . [nodes, edges, halo_in, halo_out, 1]
+  search.py      min-max repartition search over the contiguous-cut space
+  manager.py     BalanceManager: collect -> fit -> propose -> apply
+
+Entry point: ``BalanceManager.from_config(cfg)``; the trainers drive it at
+epoch boundaries (train/driver.py) and apply proposals via
+``SpmdTrainer.reshard`` (parallel/spmd.py).
+"""
+
+from roc_tpu.balance.cost_model import OnlineCostModel
+from roc_tpu.balance.manager import BalanceManager
+from roc_tpu.balance.telemetry import ShardSample, TelemetryBuffer
+
+__all__ = ["BalanceManager", "OnlineCostModel", "ShardSample",
+           "TelemetryBuffer"]

@@ -1,0 +1,130 @@
+"""ROC-style online linear cost model: t_p ~ w . [nodes, edges, halo_in, halo_out, 1].
+
+The reference fits a linear model of per-partition runtime against simple
+work counters and refits it every round as new measurements arrive.  We do
+the same with a weighted ridge least-squares over the telemetry ring buffer
+(telemetry.py).  Two deviations from a textbook lstsq, both load-bearing:
+
+  * **Warm start.**  Before any telemetry exists the model must still rank
+    cuts (epoch 0 is not allowed to be blind).  ``prior_times`` prices a
+    part with the calibrated kernel cost model the plan backends already
+    trust — ``_matmul_cost`` (ops/pallas/binned.py), the measured per-chunk
+    rate of the chunked aggregation — plus an ICI-bandwidth term for halo
+    rows.  ``fit`` mixes these as low-weight pseudo-samples, so early fits
+    interpolate between the prior and the first real probes instead of
+    extrapolating from 4 points in a 5-dim space.
+
+  * **Column scaling.**  edges ~ 1e4..1e8 while the constant column is 1;
+    unscaled normal equations lose the small coefficients.  We solve in
+    column-max-scaled space and unscale the weights.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from roc_tpu.balance.telemetry import NUM_FEATURES
+
+# Conservative per-direction ICI bandwidth used only for the prior's halo
+# term (v4-lite ~ 4.5e10 B/s per link; actual halo cost is learned).
+_PRIOR_ICI_BYTES_PER_S = 4e10
+# Feature width assumed by the prior's halo-bytes estimate (the probe's H).
+_PRIOR_HALO_WIDTH = 32
+# Relative weight of a synthesized prior sample vs a measured probe.
+PRIOR_WEIGHT = 0.1
+
+
+def prior_times(X: np.ndarray) -> np.ndarray:
+    """Warm-start prediction for feature rows [n, 5] (nodes, edges, halo_in,
+    halo_out, 1) from the plan backends' calibrated chunk cost."""
+    from roc_tpu.ops.pallas.binned import _matmul_cost
+    X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+    t = np.array([_matmul_cost(int(e), int(n)) for n, e in X[:, :2]],
+                 dtype=np.float64)
+    halo_bytes = (X[:, 2] + X[:, 3]) * _PRIOR_HALO_WIDTH * 4.0
+    return t + halo_bytes / _PRIOR_ICI_BYTES_PER_S
+
+
+class OnlineCostModel:
+    """Weighted ridge least-squares over telemetry, refit each round."""
+
+    def __init__(self, ridge: float = 1e-8):
+        self.ridge = float(ridge)
+        self.w: Optional[np.ndarray] = None  # [5], unscaled feature space
+        self.r2: Optional[float] = None      # of the last fit's probe rows
+        self.num_fits = 0
+
+    def fit(self, X: np.ndarray, t: np.ndarray,
+            weights: Optional[np.ndarray] = None,
+            prior: bool = True) -> float:
+        """Fit on measured rows (X [n, 5], t [n]); returns R^2 on those rows.
+
+        With ``prior=True`` the synthesized warm-start rows are appended at
+        ``PRIOR_WEIGHT`` — they regularize the fit but are excluded from the
+        reported R^2, so the acceptance metric reflects only how well the
+        model explains its own telemetry.
+        """
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        t = np.asarray(t, dtype=np.float64)
+        n = X.shape[0]
+        w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+        Xf, tf, wf = X, t, w
+        if prior and n:
+            Xf = np.concatenate([X, X], axis=0)
+            tf = np.concatenate([t, prior_times(X)])
+            wf = np.concatenate([w, np.full(n, PRIOR_WEIGHT)])
+        self.w = _weighted_ridge(Xf, tf, wf, self.ridge)
+        self.num_fits += 1
+        pred = X @ self.w
+        ss_res = float(np.sum(w * (t - pred) ** 2))
+        ss_tot = float(np.sum(w * (t - np.average(t, weights=w)) ** 2))
+        self.r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
+        return self.r2
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Predicted per-part time [n]; the warm-start prior until fit."""
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        if self.w is None:
+            return prior_times(X)
+        return np.maximum(X @ self.w, 0.0)
+
+    def search_weights(self) -> np.ndarray:
+        """Weights for the monotone packing search (search.py): negative
+        node/edge/halo coefficients (fit noise) clamped to 0 so part cost is
+        nondecreasing in the vertex range — the property the parametric
+        binary search and the DP both need."""
+        if self.w is None:
+            # Prior in weight form: per-edge + per-row chunk rate, halo bytes.
+            from roc_tpu.ops.pallas.binned import _MM_CHUNK_S
+            from roc_tpu.ops.pallas.segment_sum import EB, VB
+            halo = _PRIOR_HALO_WIDTH * 4.0 / _PRIOR_ICI_BYTES_PER_S
+            return np.array([_MM_CHUNK_S / VB, _MM_CHUNK_S / EB,
+                             halo, halo, 0.0])
+        w = self.w.copy()
+        w[:4] = np.maximum(w[:4], 0.0)
+        return w
+
+    def __repr__(self):
+        wtxt = None if self.w is None else np.array2string(self.w, precision=3)
+        return (f"OnlineCostModel(w={wtxt}, r2={self.r2}, "
+                f"fits={self.num_fits})")
+
+
+def _weighted_ridge(X: np.ndarray, t: np.ndarray, w: np.ndarray,
+                    ridge: float) -> np.ndarray:
+    """argmin_b sum_i w_i (t_i - X_i b)^2 + ridge |b|^2, column-scaled."""
+    scale = np.maximum(np.abs(X).max(axis=0), 1e-12)
+    Xs = X / scale
+    sw = np.sqrt(w)
+    A = Xs * sw[:, None]
+    b = t * sw
+    n, k = A.shape
+    A = np.concatenate([A, np.sqrt(ridge) * np.eye(k)], axis=0)
+    b = np.concatenate([b, np.zeros(k)])
+    sol, *_ = np.linalg.lstsq(A, b, rcond=None)
+    return sol / scale
+
+
+assert NUM_FEATURES == 5  # the fixed feature layout this module hardcodes

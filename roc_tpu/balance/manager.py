@@ -1,0 +1,192 @@
+"""BalanceManager: the collect -> fit -> propose -> apply loop.
+
+Orchestrates one "balance round" at an epoch boundary (train/driver.py):
+
+  collect  probe each part's live aggregation time (a jitted repeated
+           scatter_gather over that part's live edge slice — per-part
+           arrays are padded to a common E, so timing the padded arrays
+           would show identical work everywhere and fit nothing), plus the
+           work counters from the partition + halo structure;
+  fit      refit the online least-squares cost model on the telemetry ring;
+  propose  run the min-max repartition search under the frozen shard shape;
+  apply    hysteresis — reshard only when the predicted relative gain
+           clears ``min_gain`` AND the projected saving over the remaining
+           epochs exceeds the *measured* resharding cost.  The first apply
+           is optimistic (no measurement exists yet; applying is how we get
+           one); every later decision amortizes the measured cost.
+
+No-op safety: a proposal identical to the current cut is skipped outright,
+so a balancer whose search reproduces the static cut leaves the training
+trajectory bit-for-bit unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from roc_tpu.balance import search
+from roc_tpu.balance.cost_model import OnlineCostModel
+from roc_tpu.balance.telemetry import ShardSample, TelemetryBuffer
+from roc_tpu.graph.csr import Csr
+from roc_tpu.graph.partition import Partition
+
+# Probe geometry: feature width and the edge-op target that sets the
+# repeat count (amortizes dispatch overhead into the timed region).
+_PROBE_WIDTH = 32
+_PROBE_TARGET_EDGES = 600_000
+_PROBE_MAX_REPS = 192
+_PROBE_TRIES = 5
+
+
+@functools.lru_cache(maxsize=256)
+def _probe_fn(reps: int, part_index: int, shard_nodes: int, width: int):
+    """Jitted probe: ``reps`` chained scatter_gathers over one part's live
+    edges.  The output is written back into the padded-global table slice it
+    came from, giving each iteration a true data dependency — without it XLA
+    hoists the loop-invariant gather and the loop times nothing."""
+    import jax
+    import jax.numpy as jnp
+    from roc_tpu import ops
+
+    def run(table, src, dst):
+        def body(_, tab):
+            out = ops.scatter_gather(tab, src, dst, shard_nodes, "sum")
+            out = out / jnp.maximum(jnp.abs(out).max(), 1.0)
+            return jax.lax.dynamic_update_slice(
+                tab, out, (part_index * shard_nodes, 0))
+        return jax.lax.fori_loop(0, reps, body, table)
+
+    return jax.jit(run)
+
+
+def probe_part_times(part: Partition, width: int = _PROBE_WIDTH
+                     ) -> List[float]:
+    """Measured per-iteration aggregation time for each part's live edges."""
+    import jax.numpy as jnp
+    P, S = part.num_parts, part.shard_nodes
+    table = jnp.ones((P * S, width), jnp.float32)
+    out = []
+    for p in range(P):
+        ne = int(part.num_edges_valid[p])
+        if ne == 0:
+            out.append(0.0)
+            continue
+        src = jnp.asarray(part.edge_src[p, :ne])
+        dst = jnp.asarray(part.edge_dst[p, :ne])
+        reps = min(max(1, -(-_PROBE_TARGET_EDGES // ne)), _PROBE_MAX_REPS)
+        fn = _probe_fn(reps, p, S, width)
+        fn(table, src, dst).block_until_ready()  # compile + warm
+        best = np.inf
+        for _ in range(_PROBE_TRIES):
+            t0 = time.perf_counter()
+            fn(table, src, dst).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        out.append(best / reps)
+    return out
+
+
+class BalanceManager:
+    """Per-trainer balancer state; one instance lives for the whole run."""
+
+    def __init__(self, min_gain: float = 0.05, trace_path: str = "",
+                 telemetry: Optional[TelemetryBuffer] = None):
+        self.min_gain = float(min_gain)
+        self.model = OnlineCostModel()
+        # `is not None`, not `or`: an empty TelemetryBuffer is falsy (len 0).
+        self.telemetry = (telemetry if telemetry is not None
+                          else TelemetryBuffer(trace_path=trace_path))
+        self.reshard_cost_s: Optional[float] = None
+        self.rounds = 0
+        self.events: List[dict] = []
+
+    @classmethod
+    def from_config(cls, cfg) -> "BalanceManager":
+        return cls(min_gain=cfg.balance_min_gain,
+                   trace_path=cfg.balance_trace)
+
+    # -- the four stages --------------------------------------------------
+    def collect(self, part: Partition, graph: Csr, epoch: int
+                ) -> List[ShardSample]:
+        """Probe + counters for every part; records into the telemetry ring."""
+        times = probe_part_times(part)
+        halo_in, halo_out = search.halo_counts(graph.row_ptr, graph.col_idx,
+                                               part.bounds)
+        samples = []
+        for p in range(part.num_parts):
+            s = ShardSample(
+                epoch=epoch, part=p, time_s=float(times[p]),
+                nodes=int(part.num_valid[p]),
+                edges=int(part.num_edges_valid[p]),
+                halo_in=int(halo_in[p]), halo_out=int(halo_out[p]))
+            self.telemetry.record(s)
+            samples.append(s)
+        return samples
+
+    def fit(self) -> float:
+        X, t = self.telemetry.design()
+        if len(t) == 0:
+            return float("nan")
+        return self.model.fit(X, t)
+
+    def propose(self, part: Partition, graph: Csr):
+        """(bounds, predicted_times_new, predicted_times_current)."""
+        bounds, times = search.propose_bounds(
+            graph.row_ptr, graph.col_idx, part.num_parts, self.model,
+            max_nodes=part.shard_nodes - 1, max_edges=part.shard_edges)
+        cur = self.model.predict(
+            search.part_features(graph.row_ptr, graph.col_idx, part.bounds))
+        return bounds, times, cur
+
+    def step(self, trainer, epoch: int, remaining_epochs: int
+             ) -> Optional[dict]:
+        """One balance round against a live trainer.  Returns the decision
+        record (also appended to ``self.events`` and the JSONL trace), or
+        None when balancing is impossible for this trainer."""
+        part = getattr(trainer, "part", None)
+        if part is None:
+            return None
+        graph = trainer.dataset.graph
+        self.rounds += 1
+        self.collect(part, graph, epoch)
+        r2 = self.fit()
+        bounds, t_new, t_cur = self.propose(part, graph)
+        ev = self._decide(trainer, part, bounds, t_new, t_cur, epoch,
+                          remaining_epochs, r2)
+        self.events.append(ev)
+        self.telemetry.record_event("balance", **ev)
+        return ev
+
+    def _decide(self, trainer, part, bounds, t_new, t_cur, epoch,
+                remaining_epochs, r2) -> dict:
+        max_new, max_cur = float(np.max(t_new)), float(np.max(t_cur))
+        rel_gain = 1.0 - max_new / max_cur if max_cur > 0 else 0.0
+        ev = {"epoch": epoch, "round": self.rounds, "r2": r2,
+              "pred_max_cur_s": max_cur, "pred_max_new_s": max_new,
+              "rel_gain": rel_gain, "action": "skip"}
+        if np.array_equal(np.asarray(bounds), np.asarray(part.bounds)):
+            ev["action"] = "noop"          # proposal == current cut
+            return ev
+        if rel_gain < self.min_gain:
+            ev["reason"] = f"gain {rel_gain:.3f} < min_gain {self.min_gain}"
+            return ev
+        # Hysteresis: projected epoch-time saving over the remaining epochs
+        # must beat the measured reshard cost.  Scale the probe-level gain
+        # by the latest measured epoch time (probe seconds are per-layer
+        # aggregation iterations, not epochs).
+        epoch_s = trainer.epoch_times[-1] if getattr(
+            trainer, "epoch_times", None) else 0.0
+        if self.reshard_cost_s is not None:
+            saving = rel_gain * epoch_s * remaining_epochs
+            if saving <= self.reshard_cost_s:
+                ev["reason"] = (f"projected saving {saving:.3f}s <= measured "
+                                f"reshard cost {self.reshard_cost_s:.3f}s")
+                return ev
+        cost = trainer.reshard(np.asarray(bounds, dtype=np.int64))
+        self.reshard_cost_s = float(cost)
+        ev.update(action="reshard", reshard_cost_s=float(cost),
+                  bounds=np.asarray(bounds).tolist())
+        return ev

@@ -208,19 +208,70 @@ class Partition(PartitionMeta):
             edge_starts=self.edge_starts)
 
 
-def compute_meta(row_ptr: np.ndarray, num_parts: int) -> PartitionMeta:
-    """Partition geometry from the row pointer alone (no edge columns)."""
-    bounds = np.asarray(bounds_from_row_ptr(row_ptr, num_parts),
-                        dtype=np.int64)
+def validate_bounds(bounds: np.ndarray, num_nodes: int) -> None:
+    """Check that inclusive (lo, hi) bounds contiguously cover [0, num_nodes).
+
+    Empty parts are encoded hi < lo (the repair loops emit
+    ``(num_nodes, num_nodes - 1)``); non-empty parts must tile the vertex
+    range in ascending order with no gaps — the contract every consumer of
+    ``PartitionMeta.bounds`` (to_padded's searchsorted, the per-host byte
+    ranges, the balancer's proposals) relies on.
+    """
+    bounds = np.asarray(bounds, dtype=np.int64)
+    nxt = 0
+    for lo, hi in bounds:
+        if hi < lo:  # empty part
+            continue
+        if lo != nxt:
+            raise ValueError(
+                f"bounds not contiguous: part starts at {lo}, expected {nxt}")
+        nxt = int(hi) + 1
+    if nxt != num_nodes:
+        raise ValueError(
+            f"bounds cover [0, {nxt}) but graph has {num_nodes} nodes")
+
+
+def compute_meta(row_ptr: np.ndarray, num_parts: int,
+                 bounds: np.ndarray | None = None,
+                 shard_nodes: int | None = None,
+                 shard_edges: int | None = None) -> PartitionMeta:
+    """Partition geometry from the row pointer alone (no edge columns).
+
+    ``bounds`` overrides the greedy cut with an externally proposed cut (the
+    online balancer's path); ``shard_nodes``/``shard_edges`` force the padded
+    shard shape so a reshard keeps the *same* static S/E — jit caches and the
+    content-keyed plan cache then absorb the rebuild instead of recompiling
+    for a new shape.  Forced shapes must still fit the cut.
+    """
+    if bounds is None:
+        bounds = np.asarray(bounds_from_row_ptr(row_ptr, num_parts),
+                            dtype=np.int64)
+    else:
+        bounds = np.asarray(bounds, dtype=np.int64)
+        if bounds.shape != (num_parts, 2):
+            raise ValueError(f"bounds shape {bounds.shape} != ({num_parts}, 2)")
+        validate_bounds(bounds, len(row_ptr) - 1)
     num_valid = np.maximum(bounds[:, 1] - bounds[:, 0] + 1, 0)
     # Always leave >=1 pad row per shard so pad edges have a zero source row
     # to point at even in the fullest shard.
-    shard_nodes = _round_up(int(num_valid.max()) + 1, _NODE_ALIGN)
+    need_nodes = _round_up(int(num_valid.max()) + 1, _NODE_ALIGN)
+    if shard_nodes is None:
+        shard_nodes = need_nodes
+    elif shard_nodes < need_nodes:
+        raise ValueError(
+            f"shard_nodes={shard_nodes} cannot hold {int(num_valid.max())} "
+            f"nodes + 1 pad row (need >= {need_nodes})")
     edge_lo = row_ptr[np.maximum(bounds[:, 0], 0)]
     edge_hi = row_ptr[bounds[:, 1] + 1]
     num_edges_valid = np.where(num_valid > 0, edge_hi - edge_lo, 0)
-    shard_edges = max(_round_up(int(num_edges_valid.max()), _EDGE_ALIGN),
-                      _EDGE_ALIGN)
+    need_edges = max(_round_up(int(num_edges_valid.max()), _EDGE_ALIGN),
+                     _EDGE_ALIGN)
+    if shard_edges is None:
+        shard_edges = need_edges
+    elif shard_edges < need_edges:
+        raise ValueError(
+            f"shard_edges={shard_edges} cannot hold "
+            f"{int(num_edges_valid.max())} edges (need >= {need_edges})")
     return PartitionMeta(
         num_parts=num_parts, shard_nodes=shard_nodes,
         shard_edges=shard_edges, num_nodes=len(row_ptr) - 1,
@@ -275,10 +326,18 @@ def edge_block_arrays_t(g: Csr, part: PartitionMeta):
     return edge_block_arrays(g.transpose(), part)
 
 
-def partition_graph(g: Csr, num_parts: int) -> Partition:
-    """Partition + pad a CSR into the static shard layout described above."""
+def partition_graph(g: Csr, num_parts: int,
+                    bounds: np.ndarray | None = None,
+                    shard_nodes: int | None = None,
+                    shard_edges: int | None = None) -> Partition:
+    """Partition + pad a CSR into the static shard layout described above.
+
+    The optional overrides (see :func:`compute_meta`) are the epoch-boundary
+    resharding path: a new cut under the old padded S/E.
+    """
     g.validate()
-    meta = compute_meta(g.row_ptr, num_parts)
+    meta = compute_meta(g.row_ptr, num_parts, bounds=bounds,
+                        shard_nodes=shard_nodes, shard_edges=shard_edges)
     bounds = meta.bounds
     num_valid = meta.num_valid
     num_edges_valid = meta.num_edges_valid

@@ -1479,10 +1479,26 @@ class SpmdTrainer(BaseTrainer):
                                                             gat_backend)
         if cfg.verbose:
             self._log_shard_stats()
-        S = self.part.shard_nodes
+        # Remember the resolved backends + sharding specs: reshard() rebuilds
+        # graph data and steps from these without re-running the auto policy.
+        self._backend_resolved = backend
+        self._gat_backend_resolved = gat_backend
+        self._node_spec = NamedSharding(self.mesh, P(PARTS_AXIS))
+        self._repl_spec = NamedSharding(self.mesh, P())
 
-        node_spec = NamedSharding(self.mesh, P(PARTS_AXIS))
-        repl_spec = NamedSharding(self.mesh, P())
+        self._place_data(gd)
+
+        self.params = jax.device_put(model.init_params(self.key),
+                                     self._repl_spec)
+        self.opt_state = jax.device_put(self.optimizer.init(self.params),
+                                        self._repl_spec)
+        self._build_steps(gd)
+
+    def _place_data(self, gd: ShardedGraphData):
+        """Place the node tensors + graph data for the current partition
+        (called from _setup and again on every reshard)."""
+        ds = self.dataset
+        node_spec = self._node_spec
 
         # Node tensors: [P*S, ...], padded + permuted, sharded on axis 0 —
         # placed PER DEVICE so no host materializes the full padded array
@@ -1507,10 +1523,15 @@ class SpmdTrainer(BaseTrainer):
 
         self.gdata = self._place_parts(gd, node_spec)
 
-        self.params = jax.device_put(model.init_params(self.key), repl_spec)
-        self.opt_state = jax.device_put(self.optimizer.init(self.params),
-                                        repl_spec)
-
+    def _build_steps(self, gd: ShardedGraphData):
+        """Build the jitted shard_map step functions for a graph-data
+        pytree.  Rebuilt on reshard: the pytree STRUCTURE (plan shapes,
+        static metadata) can change with the cut, and gd_specs below is
+        derived from it — but the padded S/E stay frozen, so XLA's compile
+        cache (keyed on the HLO) absorbs the rebuild when the structure
+        comes back identical."""
+        model = self.model
+        S = self.part.shard_nodes
         exchange = self._exchange_mode
         optimizer = self.optimizer
         k = self.k
@@ -1573,3 +1594,44 @@ class SpmdTrainer(BaseTrainer):
         self._train_step = jax.jit(step_shard, donate_argnums=(0, 1))
         self._eval_step = jax.jit(eval_shard)
         self._logits_step = jax.jit(logits_shard)
+
+    # -- online load balancing (roc_tpu/balance/) -------------------------
+    def _balance_supported(self) -> bool:
+        """reshard() handles the single-process vertex-sharded modes
+        (halo / allgather exchange, k = 1).  Edge-shard mode is already
+        exactly balanced; ring and overcommit keep extra per-cut state
+        (rotation groups, stacked blocks) — ROADMAP follow-ons."""
+        return (isinstance(self.part, Partition)
+                and not self.config.perhost_load
+                and not self._use_edge_shard
+                and self._exchange_mode in ("halo", "allgather")
+                and self.k == 1
+                and jax.process_count() == 1)
+
+    def reshard(self, new_bounds: np.ndarray) -> float:
+        """Apply a repartition at an epoch boundary; returns wall seconds.
+
+        The new cut is laid out under the OLD padded shard shape
+        (partition_graph's shard_nodes/shard_edges overrides), so every
+        array keeps its static shape and dtype: the rebuilt jitted steps
+        hit XLA's compile cache whenever the plan structure is unchanged,
+        and the content-keyed ROC_PLAN_CACHE re-serves plan builds.  Params
+        and optimizer state are node-independent (GCN/GAT weights are
+        [H_in, H_out]) — no weight migration, only data placement moves.
+        """
+        import time as _time
+        assert self._balance_supported(), \
+            "reshard: unsupported trainer mode (see _balance_supported)"
+        t0 = _time.perf_counter()
+        old = self.part
+        self.part = partition_graph(
+            self.dataset.graph, old.num_parts,
+            bounds=np.asarray(new_bounds, np.int64),
+            shard_nodes=old.shard_nodes, shard_edges=old.shard_edges)
+        gd = self._build_graph_full(self._backend_resolved,
+                                    self._gat_backend_resolved)
+        self._place_data(gd)
+        self._build_steps(gd)
+        if self.config.verbose:
+            self._log_shard_stats()
+        return _time.perf_counter() - t0

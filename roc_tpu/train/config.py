@@ -90,6 +90,31 @@ class Config:
                                   # counterpart).  "off" | "on"/True |
                                   # "auto" (keep only on a measured >=10%
                                   # padded-row reduction)
+    balance_every: int = 0        # online cost-model load balancer cadence
+                                  # in epochs (roc_tpu/balance/ — ROC's
+                                  # learned repartitioner); 0 = off.  SPMD
+                                  # vertex modes only; Trainer/edge-shard/
+                                  # ring/perhost runs ignore it with a note
+    balance_min_gain: float = 0.05  # hysteresis: reshard only when the
+                                  # predicted max-part time drops by at
+                                  # least this fraction
+    balance_trace: str = ""       # JSONL telemetry trace path ("" = none)
+
+    def __post_init__(self):
+        # ROC_BALANCE* env overrides so driverless entry points (bench.py,
+        # test fixtures) can switch the balancer on without plumbing flags.
+        import os
+        env = os.environ
+        try:
+            if "ROC_BALANCE_EVERY" in env:
+                self.balance_every = int(env["ROC_BALANCE_EVERY"])
+            if "ROC_BALANCE_MIN_GAIN" in env:
+                self.balance_min_gain = float(env["ROC_BALANCE_MIN_GAIN"])
+        except ValueError:
+            raise SystemExit("ROC_BALANCE_EVERY / ROC_BALANCE_MIN_GAIN "
+                             "must be numeric")
+        if env.get("ROC_BALANCE_TRACE"):
+            self.balance_trace = env["ROC_BALANCE_TRACE"]
 
     def exchange_mode(self) -> str:
         """Effective exchange mode ('halo' | 'allgather' | 'ring')."""
@@ -142,6 +167,11 @@ def parse_args(argv: List[str]) -> Config:
                    default="auto", choices=["on", "off", "auto"])
     p.add_argument("-reorder", nargs="?", const="on", default="off",
                    choices=["on", "off", "auto"])
+    p.add_argument("-balance-every", dest="balance_every", type=int,
+                   default=0)
+    p.add_argument("-balance-min-gain", dest="balance_min_gain", type=float,
+                   default=0.05)
+    p.add_argument("-balance-trace", dest="balance_trace", default="")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

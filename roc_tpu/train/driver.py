@@ -192,6 +192,21 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
                     attend=attend)
 
 
+@dataclasses.dataclass
+class TrainStats:
+    """What one ``train()`` call measured — the single source of truth for
+    epoch timings (bench.py and the balance telemetry both consume this
+    instead of re-deriving their own).  ``epoch_times`` excludes everything
+    that happens between epochs (eval, checkpointing, balance rounds);
+    ``total_s`` includes it all."""
+
+    epoch_times: list
+    total_s: float
+    epochs: int
+    final_loss: float
+    rebalance_events: list = dataclasses.field(default_factory=list)
+
+
 class BaseTrainer:
     """Shared epoch loop, LR decay, metrics cadence, checkpointing."""
 
@@ -208,9 +223,23 @@ class BaseTrainer:
         # resolves "auto" from measured partition skew during _setup.
         self._use_edge_shard = False
         self._setup()
+        self.balancer = None
+        if config.balance_every:
+            if self._balance_supported():
+                from roc_tpu.balance.manager import BalanceManager
+                self.balancer = BalanceManager.from_config(config)
+            elif config.verbose:
+                print("# -balance-every: online balancing needs the SPMD "
+                      "vertex-sharded path (parts > 1, k = 1, no "
+                      "-perhost/-edge-shard/ring); disabled for this run")
         if config.resume and config.checkpoint_path and \
                 os.path.exists(config.checkpoint_path):
             self.restore(config.checkpoint_path)
+
+    def _balance_supported(self) -> bool:
+        """Can this trainer apply a repartition mid-run?  The SPMD trainer
+        overrides this for the modes ``reshard`` handles."""
+        return False
 
     # subclasses: place data (x/labels/mask/gdata), init params/opt_state,
     # and build the jitted self._train_step / self._eval_step
@@ -303,6 +332,8 @@ class BaseTrainer:
         prof_start = start + min(3, max(cfg.num_epochs - 1, 0))
         prof_stop = min(prof_start + 3, start + cfg.num_epochs)
         tracing = False
+        loss = float("nan")
+        rebalance_events = []
         for epoch in range(start, start + cfg.num_epochs):
             if cfg.profile_dir and epoch == prof_start:
                 jax.profiler.start_trace(cfg.profile_dir)
@@ -311,6 +342,9 @@ class BaseTrainer:
             loss = self.run_epoch()
             device_sync(loss)
             self.epoch_times.append(time.perf_counter() - te)
+            if self.balancer is not None:
+                self.balancer.telemetry.record_epoch(epoch,
+                                                     self.epoch_times[-1])
             if tracing and epoch + 1 == prof_stop:
                 device_sync(self.params)
                 jax.profiler.stop_trace()
@@ -322,6 +356,19 @@ class BaseTrainer:
             if (cfg.checkpoint_path and cfg.checkpoint_every and
                     (epoch + 1) % cfg.checkpoint_every == 0):
                 self.save_checkpoint(cfg.checkpoint_path)
+            # Balance round at the epoch boundary (never after the last
+            # epoch of this call — there would be nothing left to speed up).
+            done = epoch + 1 - start
+            if (self.balancer is not None and done < cfg.num_epochs
+                    and done % cfg.balance_every == 0):
+                ev = self.balancer.step(self, epoch + 1,
+                                        cfg.num_epochs - done)
+                if ev is not None:
+                    rebalance_events.append(ev)
+                    if cfg.verbose:
+                        print_fn(f"# balance@{epoch + 1}: {ev['action']} "
+                                 f"(pred gain {ev['rel_gain'] * 100:.1f}%, "
+                                 f"r2 {ev['r2']:.3f})")
         device_sync(self.params)
         dt = time.perf_counter() - t0
         if cfg.checkpoint_path:
@@ -333,7 +380,10 @@ class BaseTrainer:
             print_fn(f"# {cfg.num_epochs} epochs in {dt:.2f}s "
                      f"(median {med * 1e3:.1f} ms/epoch post-warmup, "
                      f"{num_edges / med / 1e6:.1f}M edges/s)")
-        return self
+        return TrainStats(
+            epoch_times=list(self.epoch_times), total_s=dt,
+            epochs=cfg.num_epochs, final_loss=float(device_sync(loss)),
+            rebalance_events=rebalance_events)
 
     # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
     def save_checkpoint(self, path: str, extra=None):

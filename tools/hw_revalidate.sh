@@ -77,6 +77,12 @@ timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
 timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-64-41 \
     -e 10 -parts 4 -model gat -heads 2 -aggr-backend matmul -v 2>&1 \
     | tail -2 | tee -a "$LOG"
+
+note "3d. balancer dryrun: 4-part overcommit with the online cost-model"
+note "    load balancer (probe -> fit -> reshard under frozen shapes;"
+note "    expect 'balance@' lines, reshard only if pred gain >= 5%)"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 8 -parts 4 -balance-every 2 -v 2>&1 | tail -4 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 4 ]; then

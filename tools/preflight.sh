@@ -1,0 +1,35 @@
+#!/bin/bash
+# Preflight gate: run the tier-1 lane (ROADMAP.md §Tier-1 verify) and
+# refuse to let a snapshot/commit proceed on red.
+#
+# Usage:
+#   bash tools/preflight.sh            # run lane, report DOTS_PASSED, exit rc
+#   bash tools/preflight.sh --commit "msg"   # lane, then git commit -am only
+#                                            # if the lane is green
+#
+# The DOTS_PASSED count is the lane's progress-dot tally — compare it
+# against the last recorded baseline (CHANGES.md) to catch silently
+# deselected tests, which a bare exit code cannot.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/_t1.log
+
+set -o pipefail
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+echo "DOTS_PASSED=$dots"
+
+if [ "$rc" -ne 0 ]; then
+    echo "preflight: tier-1 lane RED (rc=$rc) — refusing to snapshot" >&2
+    exit "$rc"
+fi
+echo "preflight: tier-1 lane green"
+
+if [ "${1:-}" = "--commit" ]; then
+    shift
+    git commit -am "${1:?--commit needs a message}"
+fi
