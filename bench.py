@@ -134,6 +134,12 @@ AB = [s.strip() for s in os.environ.get("ROC_BENCH_AB", "").split(",")
 # timed epochs — see TrainStats), but the canonical vs_baseline claim
 # stays balance-off.
 BALANCE_EVERY = _env("ROC_BENCH_BALANCE_EVERY", "0", int)
+# ROC_BENCH_ANALYZE=1: attach a static-analysis block to the artifact —
+# the lowered train/eval steps' collective counts + f64 invariants
+# (roc_tpu.analysis.audit_trainer) and the retrace-guard trace counts
+# observed across the measured window (expected: zero — any retrace there
+# is exactly the per-epoch recompile class the guard exists to catch).
+ANALYZE = _env("ROC_BENCH_ANALYZE", "0", int)
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -353,7 +359,7 @@ def run():
     from roc_tpu.graph import datasets
     from roc_tpu.models import build_model
     from roc_tpu.train.config import Config
-    from roc_tpu.train.driver import Trainer, device_sync
+    from roc_tpu.train.driver import device_sync, make_trainer
 
     if BACKEND not in ("auto", "xla", "matmul", "pallas", "binned"):
         raise ValueError(f"ROC_BENCH_BACKEND={BACKEND!r}: "
@@ -385,11 +391,7 @@ def run():
         # metric name labels what actually ran
         model = build_model(MODEL, LAYERS, cfg.dropout_rate, "",
                             heads=HEADS)
-        if n_dev > 1:
-            from roc_tpu.parallel.spmd import SpmdTrainer
-            tr = SpmdTrainer(cfg, ds, model)
-        else:
-            tr = Trainer(cfg, ds, model)
+        tr = make_trainer(cfg, ds, model)
         # device_sync fetches the loss to the host: each epoch's params feed
         # the next, so syncing the last loss transitively waits on every
         # step.  Warmup doubles as the compile check for the fallback below.
@@ -456,7 +458,13 @@ def run():
         fallback_from = type(e).__name__
     if fallback_from is not None:   # outside except: drop the failed
         trainer = build_and_warm(fb)         # trainer's HBM before rebuild
-    stats = measure(trainer)
+    guard = None
+    if ANALYZE:
+        from roc_tpu.analysis import RetraceGuard
+        with RetraceGuard(on_violation="record") as guard:
+            stats = measure(trainer)
+    else:
+        stats = measure(trainer)
     times = stats.epoch_times
     epoch_s = sum(times) / len(times)
 
@@ -504,6 +512,20 @@ def run():
     }
     if fallback_from is not None:
         result["fallback"] = f"auto failed ({fallback_from}); ran {fb}"
+    if ANALYZE:
+        from roc_tpu import analysis
+        rep = analysis.audit_trainer(trainer)
+        result["analysis"] = {
+            "key": rep.key,
+            "train_ops": rep.steps["train"]["ops"],
+            "f64_lines": rep.steps["train"]["f64_lines"],
+            "convert_f64": rep.steps["train"]["convert_f64"],
+            "invariant_violations": analysis.check_invariants(rep),
+            # traces observed during the measured window (warmup compiled
+            # everything, so anything non-zero here is a mid-run recompile)
+            "measured_retraces": guard.snapshot(),
+            "retrace_violations": guard.violations,
+        }
     if BALANCE_EVERY:
         bal = {"events": stats.rebalance_events}
         mgr = getattr(trainer, "balancer", None)

@@ -14,7 +14,7 @@ import sys
 from roc_tpu.graph import datasets
 from roc_tpu.models import build_model
 from roc_tpu.train.config import parse_args
-from roc_tpu.train.driver import Trainer
+from roc_tpu.train.driver import make_trainer
 
 
 def main(argv=None) -> int:
@@ -90,17 +90,40 @@ def main(argv=None) -> int:
     model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
                         heads=cfg.heads)
 
-    if cfg.num_parts > 1:
-        from roc_tpu.parallel.spmd import SpmdTrainer
-        trainer = SpmdTrainer(cfg, ds, model)
-        if cfg.check_sharding:
-            from roc_tpu.parallel.check import check_shard_consistency
-            check_shard_consistency(cfg, ds, model, sharded_trainer=trainer)
-            print("# shard-consistency check passed "
-                  f"({cfg.num_parts} parts, halo={cfg.halo})", file=sys.stderr)
-    else:
-        trainer = Trainer(cfg, ds, model)
-    trainer.train()
+    # One trainer build — the partition, the plans, and the compiled steps
+    # are shared by -check-sharding, -analyze, and the training run.
+    trainer = make_trainer(cfg, ds, model)
+    if cfg.check_sharding and cfg.num_parts > 1:
+        from roc_tpu.parallel.check import check_shard_consistency
+        check_shard_consistency(cfg, ds, model, sharded_trainer=trainer)
+        print("# shard-consistency check passed "
+              f"({cfg.num_parts} parts, halo={cfg.halo})", file=sys.stderr)
+
+    if not cfg.analyze:
+        trainer.train()
+        return 0
+
+    # -analyze: static audit of the lowered steps before the run, retrace
+    # report after it.  Budget diffs apply only when this exact config has
+    # a manifest entry (the committed matrix covers the roc-audit dataset);
+    # the f64/convert invariants apply to every config.
+    from roc_tpu import analysis
+    report = analysis.audit_trainer(trainer)
+    print(report.summary(), file=sys.stderr)
+    violations = analysis.check_invariants(report)
+    budgets = analysis.load_budgets()
+    if report.key in budgets:
+        violations += analysis.compare_report(report, budgets[report.key])
+    with analysis.RetraceGuard(on_violation="record") as guard:
+        trainer.train()
+    print(guard.report(), file=sys.stderr)
+    violations += guard.violations
+    if violations:
+        for v in violations:
+            print(f"# ANALYZE VIOLATION: {v}", file=sys.stderr)
+        return 3
+    print("# -analyze: clean (collective audit + retrace guard)",
+          file=sys.stderr)
     return 0
 
 

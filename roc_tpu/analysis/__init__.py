@@ -1,0 +1,44 @@
+"""roc-verify: static SPMD invariant analysis (docs/DESIGN.md §Static
+analysis).
+
+Three passes, three failure classes the runtime checker can't see:
+
+* :mod:`roc_tpu.analysis.hlo_audit` — lower each config's train/eval step
+  and diff its collectives / transfers / dtypes against ``budgets.json``
+  (catches GSPMD-inserted resharding and silent f64 upcasts);
+* :mod:`roc_tpu.analysis.retrace` — count jit tracings per step function
+  and assert steady-state epochs and same-shape reshards add zero
+  (catches per-epoch recompiles);
+* :mod:`roc_tpu.analysis.lint` — AST lint for host syncs reachable from
+  jitted code, tracer branching, unkeyed randomness, and Python closure
+  traps (catches hazards before anything is even traced).
+
+Importing this package must stay cheap and jax-free: the lint pass runs
+in CI contexts with no accelerator stack warm, so only ``hlo_audit``'s
+*functions* touch jax (lazily).
+"""
+
+from roc_tpu.analysis.hlo_audit import (  # noqa: F401
+    AuditReport,
+    AuditSpec,
+    audit_against_budgets,
+    audit_hlo_text,
+    audit_lowered,
+    audit_specs,
+    audit_trainer,
+    build_audit_trainer,
+    check_invariants,
+    compare_report,
+    load_budgets,
+    run_audit,
+    save_budgets,
+    spec_key,
+    trainer_key,
+)
+from roc_tpu.analysis.lint import Finding, lint_file, lint_paths, lint_source  # noqa: F401
+from roc_tpu.analysis.retrace import (  # noqa: F401
+    RetraceError,
+    RetraceGuard,
+    epoch_boundary,
+    note_trace,
+)

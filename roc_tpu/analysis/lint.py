@@ -1,0 +1,366 @@
+"""roclint: AST lint for SPMD / jit hazards (CLI: tools/roclint.py).
+
+The runtime checker (`parallel/check.py`) catches *value* bugs by diffing
+sharded vs single-device metrics; this pass catches the *performance and
+correctness hazards that never change a value*: a host sync hiding inside
+a jitted function (silent device→host round trip per step), Python
+control flow on a tracer, legacy global-RNG randomness, and the two
+classic Python traps (mutable default args, late-binding loop closures).
+
+Rules (waive with ``# roclint: allow(<rule>)`` on the offending or the
+preceding line):
+
+``host-sync``
+    Inside a *jitted context* (see below): ``.item()``, ``float()/int()/
+    bool()`` of a non-literal, ``np.asarray``/``np.array`` of a function
+    parameter, ``jax.device_get``, ``device_sync``,
+    ``.block_until_ready()``.  Also — anywhere — one of
+    ``block_until_ready / device_get / device_sync / .item`` inside a
+    *tight timing window* (between ``t = time.perf_counter()`` and its
+    ``... - t`` use, windows <= ``TIMED_WINDOW_MAX_LINES`` lines): a sync
+    there is being *timed*, which is either the point (waive it, saying
+    why) or a measurement bug.
+``tracer-branch``
+    ``if``/``while`` whose condition calls into ``jnp``/``jax`` inside a
+    jitted context — tracer truthiness raises on abstract values, or
+    silently specializes the trace.
+``unkeyed-rand``
+    Legacy numpy global-RNG calls (``np.random.rand/randn/seed/...``) —
+    process-global state; use ``np.random.default_rng(seed)`` or
+    ``jax.random`` keys.
+``mutable-default``
+    ``def f(x, acc=[])`` / ``={}`` / ``=set()``.
+``closure-capture``
+    A ``def``/``lambda`` inside a ``for`` body that captures the loop
+    variable freely (late binding: every closure sees the last value).
+
+A *jitted context* is a function that is (a) decorated with ``jax.jit``
+/ ``jax.shard_map`` / ``jax.custom_vjp`` (directly or via ``partial``),
+(b) passed by name to a tracing entry point (``jax.jit``, ``shard_map``,
+``jax.lax.scan/fori_loop/while_loop/cond/switch``, ``grad``,
+``value_and_grad``, ``vmap``, ``checkpoint``, ``*.defvjp``), or (c)
+syntactically nested inside one of those.  The analysis is per-file and
+does not chase calls across functions — a deliberate precision/recall
+trade (zero false positives on this tree is a pinned test).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+TIMED_WINDOW_MAX_LINES = 12
+
+# Dotted callables whose bare-Name arguments become traced functions.
+_TRACE_CALLS = {
+    "jax.jit", "jit", "jax.shard_map", "shard_map", "jax.checkpoint",
+    "jax.remat", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.custom_vjp", "jax.custom_jvp", "jax.lax.scan",
+    "jax.lax.fori_loop", "jax.lax.while_loop", "jax.lax.cond",
+    "jax.lax.switch", "jax.lax.map",
+}
+# Decorator heads that make the decorated function a traced context.
+_TRACE_DECOS = {
+    "jax.jit", "jit", "jax.shard_map", "shard_map", "jax.custom_vjp",
+    "jax.custom_jvp", "jax.checkpoint", "jax.remat", "jax.vmap",
+}
+_HOST_SYNC_FNS = {"jax.device_get", "device_get", "device_sync"}
+_TIMED_SYNC_ATTRS = {"block_until_ready", "item"}
+_LEGACY_NP_RANDOM = {
+    "rand", "randn", "randint", "random", "random_sample", "normal",
+    "uniform", "seed", "shuffle", "permutation", "choice", "binomial",
+    "poisson", "standard_normal",
+}
+_WAIVER_RE = re.compile(r"#\s*roclint:\s*allow\(([a-z\-,\s]+)\)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+def _dotted(node) -> Optional[str]:
+    """'jax.lax.scan' for Attribute chains rooted at a Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _call_head(call: ast.Call) -> Optional[str]:
+    """Dotted name of what a Call invokes; sees through partial(...)."""
+    head = _dotted(call.func)
+    if head in ("partial", "functools.partial") and call.args:
+        return _dotted(call.args[0])
+    return head
+
+
+def _deco_head(deco) -> Optional[str]:
+    if isinstance(deco, ast.Call):
+        return _call_head(deco)
+    return _dotted(deco)
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class _FileLint:
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src_lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.findings: List[Finding] = []
+        self.parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+
+    # -- helpers ----------------------------------------------------------
+    def _flag(self, node, rule: str, msg: str):
+        line = getattr(node, "lineno", 1)
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.src_lines):
+                m = _WAIVER_RE.search(self.src_lines[ln - 1])
+                if m and rule in [r.strip()
+                                  for r in m.group(1).split(",")]:
+                    return
+        self.findings.append(Finding(self.path, line, rule, msg))
+
+    def _enclosing_funcs(self, node):
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                yield cur
+            cur = self.parents.get(id(cur))
+
+    # -- jitted-context discovery ----------------------------------------
+    def _jitted_roots(self) -> Set[int]:
+        jit_names: Set[str] = set()
+        roots: Set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                head = _call_head(node)
+                if head in _TRACE_CALLS or (head or "").endswith(".defvjp"):
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            jit_names.add(a.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if _deco_head(deco) in _TRACE_DECOS:
+                        roots.add(id(node))
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in jit_names:
+                roots.add(id(node))
+        return roots
+
+    def _in_jitted(self, node, roots: Set[int]) -> Optional[ast.AST]:
+        for f in self._enclosing_funcs(node):
+            if id(f) in roots:
+                return f
+        return None
+
+    @staticmethod
+    def _params(func) -> Set[str]:
+        if isinstance(func, ast.Lambda):
+            a = func.args
+        else:
+            a = func.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        for extra in (a.vararg, a.kwarg):
+            if extra is not None:
+                names.append(extra.arg)
+        return set(names)
+
+    # -- rules ------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        roots = self._jitted_roots()
+        self._rule_jit_scope(roots)
+        self._rule_timed_windows()
+        self._rule_unkeyed_rand()
+        self._rule_mutable_default()
+        self._rule_closure_capture()
+        return self.findings
+
+    def _rule_jit_scope(self, roots: Set[int]):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                owner = self._in_jitted(node, roots)
+                if owner is None:
+                    continue
+                head = _dotted(node.func)
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("item", "block_until_ready"):
+                    self._flag(node, "host-sync",
+                               f".{node.func.attr}() inside jit-traced "
+                               f"code forces a device->host sync per call")
+                elif head in _HOST_SYNC_FNS:
+                    self._flag(node, "host-sync",
+                               f"{head}() inside jit-traced code is a "
+                               f"host transfer on every step")
+                elif head in ("float", "int", "bool") and node.args and \
+                        not isinstance(node.args[0], ast.Constant):
+                    self._flag(node, "host-sync",
+                               f"{head}(tracer) concretizes a traced "
+                               f"value (host sync / ConcretizationError)")
+                elif head in ("np.asarray", "np.array", "numpy.asarray",
+                              "numpy.array", "onp.asarray"):
+                    names = {n.id for n in ast.walk(node)
+                             if isinstance(n, ast.Name)}
+                    enclosing_params = set()
+                    for f in self._enclosing_funcs(node):
+                        enclosing_params |= self._params(f)
+                    if names & enclosing_params:
+                        self._flag(node, "host-sync",
+                                   f"{head}() of a traced argument pulls "
+                                   f"the value to the host")
+            elif isinstance(node, (ast.If, ast.While)):
+                if self._in_jitted(node, roots) is None:
+                    continue
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Call):
+                        h = _dotted(sub.func) or ""
+                        if h.split(".")[0] in ("jnp", "jax"):
+                            self._flag(
+                                node, "tracer-branch",
+                                f"Python branch on {h}(...) — tracer "
+                                f"truthiness; use jnp.where/lax.cond")
+                            break
+
+    def _rule_timed_windows(self):
+        """Host syncs inside a tight perf_counter window."""
+        for func in ast.walk(self.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            starts: Dict[str, int] = {}
+            ends: Dict[str, int] = {}
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    if any(isinstance(c, ast.Call)
+                           and (_dotted(c.func) or "").endswith(
+                               "perf_counter")
+                           for c in ast.walk(node.value)):
+                        t = node.targets[0].id
+                        starts.setdefault(t, node.lineno)
+                elif isinstance(node, ast.BinOp) and \
+                        isinstance(node.op, ast.Sub) and \
+                        isinstance(node.right, ast.Name) and \
+                        node.right.id in starts and \
+                        node.lineno > starts[node.right.id]:
+                    t = node.right.id
+                    if t not in ends:
+                        ends[t] = node.lineno
+            for t, lo in starts.items():
+                hi = ends.get(t)
+                if hi is None or hi - lo > TIMED_WINDOW_MAX_LINES:
+                    continue
+                for node in ast.walk(func):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if not (lo < getattr(node, "lineno", 0) < hi):
+                        continue
+                    name = None
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _TIMED_SYNC_ATTRS:
+                        name = "." + node.func.attr + "()"
+                    elif _dotted(node.func) in _HOST_SYNC_FNS:
+                        name = _dotted(node.func) + "()"
+                    if name:
+                        self._flag(
+                            node, "host-sync",
+                            f"{name} inside the timed window of "
+                            f"{t!r} ({lo}..{hi}) — timing a host sync; "
+                            f"move it out or waive with a justification")
+
+    def _rule_unkeyed_rand(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                head = _dotted(node.func) or ""
+                parts = head.split(".")
+                if len(parts) == 3 and parts[0] in ("np", "numpy") and \
+                        parts[1] == "random" and \
+                        parts[2] in _LEGACY_NP_RANDOM:
+                    self._flag(node, "unkeyed-rand",
+                               f"{head}() uses the process-global legacy "
+                               f"RNG; use np.random.default_rng(seed)")
+
+    def _rule_mutable_default(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            defaults = list(node.args.defaults) + \
+                [d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and _dotted(d.func) in ("list", "dict", "set")
+                    and not d.args and not d.keywords)
+                if bad:
+                    self._flag(d, "mutable-default",
+                               "mutable default argument is shared "
+                               "across calls; default to None")
+
+    def _rule_closure_capture(self):
+        for loop in ast.walk(self.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor)):
+                continue
+            targets = {n.id for n in ast.walk(loop.target)
+                       if isinstance(n, ast.Name)}
+            for node in ast.walk(loop):
+                if node is loop or not isinstance(node, _FUNC_NODES):
+                    continue
+                bound = self._params(node)
+                # names the closure assigns locally are not captures
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and \
+                            isinstance(sub.ctx, ast.Store):
+                        bound.add(sub.id)
+                free = {n.id for n in ast.walk(node)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)} - bound
+                captured = free & targets
+                if captured:
+                    self._flag(node, "closure-capture",
+                               f"closure captures loop variable(s) "
+                               f"{sorted(captured)} by reference (late "
+                               f"binding); bind via default arg")
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    return _FileLint(path, src).run()
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths) -> List[Finding]:
+    """Lint files and/or directory trees (``.py`` only)."""
+    out: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.extend(lint_file(os.path.join(root, fn)))
+        elif p.endswith(".py"):
+            out.extend(lint_file(p))
+    return sorted(out, key=lambda f: (f.path, f.line))

@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from roc_tpu import ops
+from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph.partition import (Partition, edge_block_arrays,
                                      edge_block_arrays_t, partition_graph)
 from roc_tpu.models.model import GraphCtx
@@ -1535,6 +1536,20 @@ class SpmdTrainer(BaseTrainer):
         exchange = self._exchange_mode
         optimizer = self.optimizer
         k = self.k
+        # Same-structure rebuilds (a balancer reshard that kept every plan
+        # shape) must not even re-trace: reuse the SAME jitted callables,
+        # keyed on the graph pytree's structure + leaf shapes/dtypes (the
+        # static half of jax's own cache key).  This is what lets the
+        # retrace guard (analysis/retrace.py) assert literal zero.
+        sig = (S, exchange, k,
+               jax.tree_util.tree_structure(gd),
+               tuple((tuple(leaf.shape), str(leaf.dtype))
+                     for leaf in jax.tree_util.tree_leaves(gd)))
+        cache = self.__dict__.setdefault("_step_cache", {})
+        cached = cache.get(sig)
+        if cached is not None:
+            self._train_step, self._eval_step, self._logits_step = cached
+            return
         # pallas_call can't annotate vma yet; the matmul backend is plain
         # XLA.  Binned pallas plans can live in `plans` (fused exchange) OR
         # in the halo-overlap split pair `plans_local`/`plans_remote` —
@@ -1561,6 +1576,8 @@ class SpmdTrainer(BaseTrainer):
                            P(PARTS_AXIS), gd_specs, P(), P()),
                  out_specs=(P(), P(), P()))
         def step_shard(params, opt_state, x, labels, mask, gd, key, alpha):
+            # this body only runs while jax traces it — a retrace counter
+            _retrace.note_trace("train_step")
             # per-device dropout masks: fold the device index into the key
             # (k stacked parts draw distinct rows of the same stream)
             key = jax.random.fold_in(key, jax.lax.axis_index(PARTS_AXIS))
@@ -1579,6 +1596,7 @@ class SpmdTrainer(BaseTrainer):
                            gd_specs),
                  out_specs=P())
         def eval_shard(params, x, labels, mask, gd):
+            _retrace.note_trace("eval_step")
             gctx = block_gctx(gd)
             logits = model.apply(params, x, gctx, train=False)
             m = ops.perf_metrics(logits, labels, mask)
@@ -1588,12 +1606,14 @@ class SpmdTrainer(BaseTrainer):
                  in_specs=(P(), P(PARTS_AXIS), gd_specs),
                  out_specs=P(PARTS_AXIS))
         def logits_shard(params, x, gd):
+            _retrace.note_trace("logits_step")
             gctx = block_gctx(gd)
             return model.apply(params, x, gctx, train=False)
 
         self._train_step = jax.jit(step_shard, donate_argnums=(0, 1))
         self._eval_step = jax.jit(eval_shard)
         self._logits_step = jax.jit(logits_shard)
+        cache[sig] = (self._train_step, self._eval_step, self._logits_step)
 
     # -- online load balancing (roc_tpu/balance/) -------------------------
     def _balance_supported(self) -> bool:

@@ -73,6 +73,12 @@ class Config:
                                   # -no-halo-overlap restores the
                                   # materialize-then-aggregate path
     check_sharding: bool = False  # validate sharded == single-device first
+    analyze: bool = False         # static audit before + retrace report
+                                  # after the run (roc_tpu/analysis/):
+                                  # collective/f64 audit of the lowered
+                                  # steps, budget diff when the config has
+                                  # a budgets.json entry, RetraceGuard in
+                                  # record mode around train()
     profile_dir: str = ""         # write a jax.profiler trace of epochs 3-5
     multihost: bool = False       # jax.distributed.initialize() before run
     perhost_load: bool = False    # each process reads only its parts' .lux
@@ -160,6 +166,7 @@ def parse_args(argv: List[str]) -> Config:
                    choices=["", "halo", "allgather", "ring"])
     p.add_argument("-check-sharding", dest="check_sharding",
                    action="store_true")
+    p.add_argument("-analyze", dest="analyze", action="store_true")
     p.add_argument("-profile", dest="profile_dir", default="")
     p.add_argument("-multihost", action="store_true")
     p.add_argument("-perhost", dest="perhost_load", action="store_true")

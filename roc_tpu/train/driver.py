@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from roc_tpu import ops
+from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph.datasets import Dataset
 from roc_tpu.models.model import GraphCtx, Model
 from roc_tpu.ops.softmax import format_metrics
@@ -340,7 +341,9 @@ class BaseTrainer:
                 tracing = True
             te = time.perf_counter()
             loss = self.run_epoch()
-            device_sync(loss)
+            # the sync IS the measurement: an epoch "ends" when its result
+            # reaches the host, not when dispatch returns
+            device_sync(loss)  # roclint: allow(host-sync)
             self.epoch_times.append(time.perf_counter() - te)
             if self.balancer is not None:
                 self.balancer.telemetry.record_epoch(epoch,
@@ -369,6 +372,9 @@ class BaseTrainer:
                         print_fn(f"# balance@{epoch + 1}: {ev['action']} "
                                  f"(pred gain {ev['rel_gain'] * 100:.1f}%, "
                                  f"r2 {ev['r2']:.3f})")
+            # After the balance round, so an armed RetraceGuard sees a
+            # reshard's (cache-missing) rebuild as the violation it is.
+            _retrace.epoch_boundary(done)
         device_sync(self.params)
         dt = time.perf_counter() - t0
         if cfg.checkpoint_path:
@@ -425,6 +431,7 @@ class Trainer(BaseTrainer):
 
         @jax.jit
         def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
+            _retrace.note_trace("train_step")
             gctx = make_gctx(gdata, n)
             loss, grads = jax.value_and_grad(model.loss)(
                 params, x, labels, mask, gctx, key=key, train=True)
@@ -434,14 +441,27 @@ class Trainer(BaseTrainer):
 
         @jax.jit
         def eval_step(params, x, labels, mask, gdata):
+            _retrace.note_trace("eval_step")
             gctx = make_gctx(gdata, n)
             logits = model.apply(params, x, gctx, train=False)
             return ops.perf_metrics(logits, labels, mask)
 
         @jax.jit
         def logits_step(params, x, gdata):
+            _retrace.note_trace("logits_step")
             return model.apply(params, x, make_gctx(gdata, n), train=False)
 
         self._train_step = train_step
         self._eval_step = eval_step
         self._logits_step = logits_step
+
+
+def make_trainer(config: Config, dataset: Dataset, model: Model) -> BaseTrainer:
+    """The one place that picks Trainer vs SpmdTrainer.  Both the CLI's
+    `-check-sharding` and `-analyze` paths, the audit matrix, and bench.py
+    go through here so a trainer (and its partition + compiled steps) is
+    built exactly once and reused."""
+    if config.num_parts > 1:
+        from roc_tpu.parallel.spmd import SpmdTrainer
+        return SpmdTrainer(config, dataset, model)
+    return Trainer(config, dataset, model)

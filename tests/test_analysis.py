@@ -1,0 +1,188 @@
+"""roc-verify tests: collective auditor, retrace guard, roclint.
+
+Three layers of evidence, matching the subsystem's three passes:
+  * the audit matrix is CLEAN against the committed budgets.json, and
+    seeded mutations (a replicated input that should be parts-sharded; an
+    exchange-mode flip audited against the halo budget) are flagged;
+  * the retrace guard proves literal-zero retraces across steady-state
+    epochs AND across a same-cut balancer reshard (the frozen-shape
+    invariant as an enforced property);
+  * roclint fires on positive fixture snippets, stays silent on clean
+    near-misses, honors waivers, and reports zero findings on the tree.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from roc_tpu.analysis import (AuditSpec, audit_specs, audit_trainer,
+                              build_audit_trainer, check_invariants,
+                              compare_report, load_budgets, spec_key)
+from roc_tpu.analysis import lint, retrace
+from roc_tpu.analysis.retrace import RetraceError, RetraceGuard
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def budgets():
+    b = load_budgets()
+    assert b, "budgets.json missing; run tools/roclint.py --update-budgets"
+    return b
+
+
+# -- collective auditor ---------------------------------------------------
+
+def test_manifest_covers_matrix(budgets):
+    assert set(budgets) == {spec_key(s) for s in audit_specs()}
+
+
+@pytest.mark.parametrize("spec", audit_specs(), ids=spec_key)
+def test_audit_clean_tree(spec, budgets):
+    """Every model x parts x backend x exchange entry lowers to exactly
+    its budgeted collectives, with no f64 and unchanged shardings."""
+    rep = audit_trainer(build_audit_trainer(spec), key=spec_key(spec))
+    assert compare_report(rep, budgets[spec_key(spec)]) == []
+    assert check_invariants(rep) == []
+
+
+def test_audit_flags_replicated_input(budgets):
+    """Seeded mutation: re-place x replicated (the 'dropped
+    with_sharding_constraint' analog) — the entry-arg sharding signature
+    diff catches it before any op count moves."""
+    import jax
+    spec = AuditSpec("gcn", 4, "matmul", "halo")
+    tr = build_audit_trainer(spec)
+    key = spec_key(spec)
+    assert compare_report(audit_trainer(tr, key=key), budgets[key]) == []
+    tr.x = jax.device_put(np.asarray(tr.x), tr._repl_spec)
+    viol = compare_report(audit_trainer(tr, key=key), budgets[key])
+    assert any("sharding" in v for v in viol), viol
+
+
+def test_audit_flags_exchange_flip(budgets):
+    """Seeded mutation: lower the allgather-exchange program but audit it
+    against the halo budget — the halo all_to_all quota and the uninvited
+    all_gather/reduce_scatter both fire."""
+    spec = AuditSpec("gcn", 2, "matmul", "halo")
+    tr = build_audit_trainer(spec, exchange="allgather")
+    viol = compare_report(audit_trainer(tr, key=spec_key(spec)),
+                          budgets[spec_key(spec)])
+    assert any("all_to_all" in v for v in viol), viol
+    assert any("all_gather" in v for v in viol), viol
+
+
+# -- retrace guard --------------------------------------------------------
+
+def test_retrace_guard_mechanics():
+    with RetraceGuard(warmup=1) as g:
+        retrace.note_trace("train_step")      # first-epoch trace: allowed
+        retrace.epoch_boundary(1)             # warmup boundary -> armed
+        with pytest.raises(RetraceError):
+            retrace.note_trace("train_step")
+    assert retrace.active() is None
+    with RetraceGuard(on_violation="record") as g:
+        g.arm()
+        retrace.note_trace("eval_step")
+        assert len(g.violations) == 1
+        with pytest.raises(RetraceError):
+            g.assert_clean()
+    assert g.counts["eval_step"] == 1
+
+
+def test_zero_retraces_across_epochs_and_reshard():
+    """3-epoch run + a same-cut reshard: the step cache returns the SAME
+    jitted callables and nothing re-traces."""
+    spec = AuditSpec("gcn", 2, "matmul", "halo")
+    tr = build_audit_trainer(spec)
+    tr.config.num_epochs = 3
+    with RetraceGuard(warmup=1) as g:        # raises on any 2..N retrace
+        tr.train(print_fn=lambda *a, **k: None)
+        assert g.counts["train_step"] >= 1
+        snap = g.snapshot()
+        step_ids = (id(tr._train_step), id(tr._eval_step))
+        tr.reshard(tr.part.bounds)           # same cut, same shapes
+        assert (id(tr._train_step), id(tr._eval_step)) == step_ids
+        g.arm()
+        tr.run_epoch()                       # post-reshard epoch
+        tr.evaluate()
+        g.assert_no_new_traces(snap)
+
+
+# -- roclint --------------------------------------------------------------
+
+_POSITIVE = {
+    "host-sync": [
+        "import jax\n@jax.jit\ndef f(x):\n    return x.sum().item()\n",
+        "import jax\ndef inner(x):\n    return float(x)\n"
+        "g = jax.jit(inner)\n",
+        "import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+        "    return np.asarray(x) + 1\n",
+        "import jax\n@jax.jit\ndef f(x):\n    return jax.device_get(x)\n",
+        "import time\ndef bench(fn, x):\n    t0 = time.perf_counter()\n"
+        "    fn(x).block_until_ready()\n"
+        "    return time.perf_counter() - t0\n",
+    ],
+    "tracer-branch": [
+        "import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+        "    if jnp.any(x > 0):\n        return x\n    return -x\n",
+    ],
+    "unkeyed-rand": ["import numpy as np\ni = np.random.randint(0, 9)\n"],
+    "mutable-default": ["def f(x, acc=[]):\n    acc.append(x)\n"
+                        "    return acc\n"],
+    "closure-capture": ["fns = []\nfor i in range(3):\n"
+                        "    fns.append(lambda: i + 1)\n"],
+}
+
+_CLEAN = [
+    # host syncs OUTSIDE jitted code / timing windows are fine
+    "def log(x):\n    return x.item()\n",
+    # static-python branch inside jit is fine
+    "import jax\n@jax.jit\ndef f(x, mode='sum'):\n"
+    "    if mode == 'sum':\n        return x.sum()\n    return x.max()\n",
+    # seeded generator API is the sanctioned randomness
+    "import numpy as np\nrng = np.random.default_rng(0)\n"
+    "i = rng.integers(0, 9)\n",
+    "def f(x, acc=None):\n    return (acc or []) + [x]\n",
+    # loop var bound through a default arg: no late binding
+    "fns = []\nfor i in range(3):\n    fns.append(lambda i=i: i + 1)\n",
+    # long timing window (a whole epoch loop): syncs inside are the
+    # workload, not the measurement artifact
+    "import time\ndef run(fn, x):\n    t0 = time.perf_counter()\n"
+    + "    x = fn(x)\n" * 14
+    + "    x.block_until_ready()\n    return time.perf_counter() - t0\n",
+]
+
+
+@pytest.mark.parametrize("rule", sorted(_POSITIVE))
+def test_lint_positive(rule):
+    for src in _POSITIVE[rule]:
+        fs = lint.lint_source(src, f"<{rule}>")
+        assert any(f.rule == rule for f in fs), (rule, src, fs)
+
+
+def test_lint_clean_snippets():
+    for src in _CLEAN:
+        assert lint.lint_source(src) == [], src
+
+
+def test_lint_waiver():
+    src = ("import jax\n@jax.jit\ndef f(x):\n"
+           "    return x.sum().item()  # roclint: allow(host-sync)\n")
+    assert lint.lint_source(src) == []
+    # a waiver for a different rule does not silence it
+    src2 = src.replace("allow(host-sync)", "allow(unkeyed-rand)")
+    assert len(lint.lint_source(src2)) == 1
+
+
+def test_lint_zero_false_positives_on_tree():
+    paths = [os.path.join(ROOT, "roc_tpu"), os.path.join(ROOT, "tools"),
+             os.path.join(ROOT, "bench.py")]
+    assert lint.lint_paths(paths) == []
+
+
+def test_analyze_flag_parses():
+    from roc_tpu.train.config import parse_args
+    cfg = parse_args(["-dataset", "x", "-layers", "8-4", "-analyze"])
+    assert cfg.analyze and not parse_args(["-layers", "8-4"]).analyze

@@ -15,10 +15,10 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=/tmp/hw_revalidate.log
-START=${1:-1}
+START=${1:-0}
 case "$START" in
-    [1-5]) ;;
-    *) echo "usage: $0 [start-step 1-5]" >&2; exit 2 ;;
+    [0-5]) ;;
+    *) echo "usage: $0 [start-step 0-5]" >&2; exit 2 ;;
 esac
 : > "$LOG"
 
@@ -27,6 +27,17 @@ note() { echo "== $*" | tee -a "$LOG"; }
 note "probe"
 timeout 60 python -c "import jax; print(jax.devices())" 2>&1 | tail -1 \
     | tee -a "$LOG" || { note "tunnel down; aborting"; exit 1; }
+
+if [ "$START" -le 0 ]; then
+note "0. static analysis gate (roclint + collective budget audit) — no"
+note "   TPU minutes spent: catches host syncs / budget drift before the"
+note "   window burns on a program we would reject anyway"
+timeout 120 python tools/roclint.py 2>&1 | tail -2 | tee -a "$LOG" \
+    || { note "roclint findings; fix or waive before burning the window"; \
+         exit 1; }
+timeout 600 python tools/roclint.py --audit --no-lint 2>&1 | tail -2 \
+    | tee -a "$LOG" || { note "budget audit red; investigate first"; exit 1; }
+fi
 
 if [ "$START" -le 1 ]; then
 note "1. bench shipped defaults (THE headline; expect binned, ~0.63 s/epoch)"

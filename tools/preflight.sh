@@ -15,6 +15,19 @@ cd "$(dirname "$0")/.."
 LOG=/tmp/_t1.log
 
 set -o pipefail
+
+# Stage 0: static analysis (roc_tpu/analysis/) — AST lint over the tree,
+# then the collective budget audit (lowering only; CPU suffices).  Red
+# here means a host sync / tracer hazard crept in, or a config's compiled
+# communication drifted from budgets.json (regenerate DELIBERATE drifts
+# with tools/roclint.py --update-budgets and review the manifest diff).
+echo "== roclint =="
+python tools/roclint.py || {
+    echo "preflight: roclint findings — refusing to snapshot" >&2; exit 1; }
+echo "== budget audit =="
+timeout -k 10 600 python tools/roclint.py --audit --no-lint || {
+    echo "preflight: collective budget audit RED" >&2; exit 1; }
+
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""roclint — static SPMD invariant checks for the roc_tpu tree.
+
+    python tools/roclint.py [paths...]        AST lint (default: the tree)
+    python tools/roclint.py --audit           collective budget audit
+    python tools/roclint.py --update-budgets  regenerate budgets.json
+
+The lint pass is pure AST — no jax, no devices, milliseconds.  The audit
+pass lowers the train/eval step of every config in the audit matrix
+(roc_tpu.analysis.hlo_audit.audit_specs) and diffs collectives/dtypes/
+shardings against roc_tpu/analysis/budgets.json; lowering needs no
+accelerator, so both run in CPU-only CI.  The audit pins JAX to CPU with
+8 forced host devices — the manifest is only meaningful under that
+topology (same pin as tests/conftest.py).
+
+Exit status: 0 clean, 1 findings/violations, 2 usage error.
+"""
+
+import argparse
+import os
+import sys
+
+DEFAULT_PATHS = ["roc_tpu", "tools", "bench.py"]
+
+
+def _pin_cpu_topology():
+    """Must run before jax is imported anywhere in this process."""
+    if "jax" in sys.modules:
+        print("# roclint: jax already imported; cannot pin the 8-device "
+              "CPU topology the budgets were recorded under",
+              file=sys.stderr)
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="roclint", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint "
+                    "(default: roc_tpu tools bench.py)")
+    ap.add_argument("--audit", action="store_true",
+                    help="lower the audit matrix and diff against "
+                    "budgets.json (skips the lint pass unless paths given)")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="regenerate roc_tpu/analysis/budgets.json from "
+                    "the current tree")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the AST lint pass")
+    args = ap.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo)
+    sys.path.insert(0, repo)
+
+    rc = 0
+    do_lint = not args.no_lint and (
+        bool(args.paths) or not (args.audit or args.update_budgets))
+    if do_lint:
+        from roc_tpu.analysis import lint
+        findings = lint.lint_paths(args.paths or DEFAULT_PATHS)
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print(f"# roclint: {n} finding(s)", file=sys.stderr)
+        if n:
+            rc = 1
+
+    if args.audit or args.update_budgets:
+        _pin_cpu_topology()
+        from roc_tpu.analysis import hlo_audit
+
+        def progress(key):
+            print(f"#   lowering {key}", file=sys.stderr)
+
+        if args.update_budgets:
+            budgets = hlo_audit.run_audit(progress=progress)
+            hlo_audit.save_budgets(budgets)
+            print(f"# roclint: wrote {len(budgets)} budget entr(y/ies) to "
+                  f"{hlo_audit.BUDGETS_PATH}", file=sys.stderr)
+        else:
+            viol = hlo_audit.audit_against_budgets(progress=progress)
+            for v in viol:
+                print(f"BUDGET VIOLATION: {v}")
+            print(f"# roclint audit: {len(viol)} violation(s)",
+                  file=sys.stderr)
+            if viol:
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
