@@ -37,6 +37,11 @@ Env knobs:
                      every leg in THIS process (same dataset/warmup, per-
                      epoch times in the artifact); value = slowest/fastest
                      ratio, unit "x" — the forced-vs-auto anomaly check
+  ROC_BENCH_MEM      1: attach a memory-planner block to the artifact —
+                     the chosen plan (ROC_MEM_PLAN / ROC_MEM_BUDGET drive
+                     it through Config), predicted vs measured peak HBM
+                     bytes, and the predicted step-time delta vs all-KEEP
+                     and all-REMAT (roc_tpu/memory)
 """
 
 import json
@@ -140,6 +145,13 @@ BALANCE_EVERY = _env("ROC_BENCH_BALANCE_EVERY", "0", int)
 # observed across the measured window (expected: zero — any retrace there
 # is exactly the per-epoch recompile class the guard exists to catch).
 ANALYZE = _env("ROC_BENCH_ANALYZE", "0", int)
+# ROC_BENCH_MEM=1: attach the memory-planner artifact block (see module
+# docstring).  The plan itself comes from ROC_MEM_PLAN / ROC_MEM_BUDGET,
+# which Config.__post_init__ reads when build_and_warm constructs it; a
+# non-default plan changes the traced program, so it annotates the metric
+# and the canonical vs_baseline / last-known-good claims stay plan-off.
+MEM = _env("ROC_BENCH_MEM", "0", int)
+MEM_PLAN = os.environ.get("ROC_MEM_PLAN", "keep")
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -155,7 +167,8 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + ("" if PRECISION == "fast" else f"_{PRECISION}")
           + ("" if REORDER == "off" else f"_reorder-{REORDER}")
           + ("" if INTER == "uniform" else f"_inter-{INTER}")
-          + ("" if BALANCE_EVERY == 0 else f"_balance{BALANCE_EVERY}"))
+          + ("" if BALANCE_EVERY == 0 else f"_balance{BALANCE_EVERY}")
+          + ("" if MEM_PLAN == "keep" else f"_mem-{MEM_PLAN}"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -497,7 +510,7 @@ def run():
         # mislead even though the metric name is annotated)
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
         if MODEL == "gcn" and CANONICAL_SHAPE and REORDER == "off"
-        and BALANCE_EVERY == 0 else None,
+        and BALANCE_EVERY == 0 and MEM_PLAN == "keep" else None,
         "backend": resolved,                   # what auto resolved to
         "platform": jax.default_backend(),
         "edges_per_sec_per_chip": round(edges_per_sec_per_chip),
@@ -537,9 +550,37 @@ def run():
         else:                        # e.g. single device -> Trainer path
             bal["note"] = "balancer unsupported for this trainer mode"
         result["balance"] = bal
+    if MEM:
+        from roc_tpu import memory
+        est = getattr(trainer, "mem_estimate", None)
+        plan = getattr(trainer, "mem_plan", None)
+        mem = {"note": "trainer built without a memory plan"}
+        if plan is not None and est is not None:
+            # all-KEEP / all-REMAT reference points come from the same
+            # estimate the chosen plan was optimized against, so the deltas
+            # are exactly what the DP traded off (predicted, not re-run —
+            # measuring three warm programs would triple the bench budget)
+            keep = memory.plan_memory(est, mode="keep")
+            remat = memory.plan_memory(est, mode="remat")
+            mem = {
+                "plan": plan.to_dict(),
+                "predicted_peak_bytes": plan.predicted_peak_bytes,
+                "measured_peak_bytes": memory.measured_peak_bytes(),
+                "epoch_peak_hbm_bytes": (stats.peak_hbm_bytes[-1]
+                                         if stats.peak_hbm_bytes else None),
+                "peak_hbm_source": stats.peak_hbm_source,
+                "keep_peak_bytes": keep.predicted_peak_bytes,
+                "remat_peak_bytes": remat.predicted_peak_bytes,
+                "step_delta_vs_keep": round(
+                    plan.predicted_step_s / keep.predicted_step_s - 1, 4),
+                "step_delta_vs_remat": round(
+                    plan.predicted_step_s / remat.predicted_step_s - 1, 4),
+            }
+        result["memory"] = mem
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0
+            and MEM_PLAN == "keep"
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

@@ -165,9 +165,10 @@ def trainer_key(trainer) -> str:
             f"{cfg.aggregate_backend}/{exch}")
 
 
-def audit_trainer(trainer, key: Optional[str] = None) -> AuditReport:
-    """Lower the trainer's compiled train/eval steps with its real
-    arguments and audit the StableHLO.  Lowering only — nothing runs."""
+def lower_steps(trainer) -> Dict[str, object]:
+    """Lower the trainer's jitted train/eval steps with its real arguments
+    (lowering only — nothing runs).  Shared by the HLO audit below and the
+    memory estimator's XLA cross-checks (roc_tpu/memory/estimator.py)."""
     import jax
     import jax.numpy as jnp
     rng = jax.random.PRNGKey(0)
@@ -178,7 +179,13 @@ def audit_trainer(trainer, key: Optional[str] = None) -> AuditReport:
     lo_eval = trainer._eval_step.lower(
         trainer.params, trainer.x, trainer.labels, trainer.mask,
         trainer.gdata)
-    lowereds = {"train": lo_train, "eval": lo_eval}
+    return {"train": lo_train, "eval": lo_eval}
+
+
+def audit_trainer(trainer, key: Optional[str] = None) -> AuditReport:
+    """Lower the trainer's compiled train/eval steps with its real
+    arguments and audit the StableHLO."""
+    lowereds = lower_steps(trainer)
     return AuditReport(key=key or trainer_key(trainer),
                        steps={n: audit_lowered(lo)
                               for n, lo in lowereds.items()},

@@ -33,6 +33,13 @@ preceding line):
 ``closure-capture``
     A ``def``/``lambda`` inside a ``for`` body that captures the loop
     variable freely (late binding: every closure sees the last value).
+``remat``
+    A raw ``jax.checkpoint`` / ``jax.remat`` call outside
+    ``roc_tpu/memory/policy.py`` — ad-hoc rematerialization bypasses the
+    memory planner's budget accounting (activation plans must go through
+    ``-mem-plan``); policy.py is the one sanctioned call site.  Scan-body
+    remat (where the plan abstraction doesn't apply) carries explicit
+    waivers.
 
 A *jitted context* is a function that is (a) decorated with ``jax.jit``
 / ``jax.shard_map`` / ``jax.custom_vjp`` (directly or via ``partial``),
@@ -75,6 +82,15 @@ _LEGACY_NP_RANDOM = {
     "poisson", "standard_normal",
 }
 _WAIVER_RE = re.compile(r"#\s*roclint:\s*allow\(([a-z\-,\s]+)\)")
+# Raw rematerialization entry points (the `remat` rule); ad_checkpoint
+# spellings included so the rule can't be dodged by import path.
+_REMAT_CALLS = {
+    "jax.checkpoint", "jax.remat", "jax.ad_checkpoint.checkpoint",
+    "ad_checkpoint.checkpoint", "checkpoint", "remat",
+}
+# The one module allowed to call them: the memory planner's policy
+# compiler (plans are budgeted there; see roc_tpu/memory).
+_REMAT_EXEMPT_SUFFIX = os.path.join("roc_tpu", "memory", "policy.py")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,6 +209,7 @@ class _FileLint:
         self._rule_unkeyed_rand()
         self._rule_mutable_default()
         self._rule_closure_capture()
+        self._rule_remat()
         return self.findings
 
     def _rule_jit_scope(self, roots: Set[int]):
@@ -314,6 +331,19 @@ class _FileLint:
                     self._flag(d, "mutable-default",
                                "mutable default argument is shared "
                                "across calls; default to None")
+
+    def _rule_remat(self):
+        """Raw jax.checkpoint/jax.remat outside the memory policy module."""
+        if self.path.replace("/", os.sep).endswith(_REMAT_EXEMPT_SUFFIX):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    _call_head(node) in _REMAT_CALLS:
+                self._flag(node, "remat",
+                           f"raw {_call_head(node)}() bypasses the memory "
+                           f"planner's budget accounting; route remat "
+                           f"through roc_tpu/memory (-mem-plan) or waive "
+                           f"with a rationale")
 
     def _rule_closure_capture(self):
         for loop in ast.walk(self.tree):

@@ -63,10 +63,19 @@ class TelemetryBuffer:
         self._emit({"type": "shard", **dataclasses.asdict(sample)})
 
     def record_epoch(self, epoch: int, wall_s: float,
-                     loss: Optional[float] = None) -> None:
+                     loss: Optional[float] = None,
+                     peak_hbm: Optional[int] = None,
+                     peak_hbm_source: str = "") -> None:
+        """``peak_hbm``: per-device peak HBM bytes for the epoch —
+        device-reported where the backend exposes memory_stats (TPU), the
+        memory planner's prediction otherwise; ``peak_hbm_source`` says
+        which ("measured" | "estimated")."""
         rec = {"type": "epoch", "epoch": epoch, "wall_s": round(wall_s, 6)}
         if loss is not None:
             rec["loss"] = float(loss)
+        if peak_hbm is not None:
+            rec["peak_hbm_bytes"] = int(peak_hbm)
+            rec["peak_hbm_source"] = peak_hbm_source
         self._emit(rec)
 
     def record_event(self, kind: str, **fields) -> None:

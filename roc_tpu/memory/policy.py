@@ -1,0 +1,74 @@
+"""Compile a MemPlan into a jax.checkpoint policy over named intermediates.
+
+Models tag every op output with ``jax.ad_checkpoint.checkpoint_name``
+(stable ``L<layer>.<kind><id>`` names derived from the op IR, so the same
+model always produces the same name set — models/model.py).  An active
+plan wraps the forward pass in ``jax.checkpoint`` with
+``save_only_these_names`` over the tagged outputs of KEPT layers: those
+tensors survive to the backward pass, everything else (rematted layers
+wholesale, plus the elementwise interiors of kept layers — the per-tensor
+granularity decision, estimator.py) is recomputed.
+
+This module is the ONE place the tree is allowed to call
+``jax.checkpoint`` directly — roclint's ``remat`` rule flags it anywhere
+else, so ad-hoc remat can't silently bypass the planner's budget
+accounting.  An all-KEEP plan compiles to ``None`` (no wrap): the default
+autodiff residual behavior, byte-identical to the pre-planner programs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from roc_tpu import ops
+from roc_tpu.memory.planner import KEEP, MemPlan
+
+try:
+    from jax import checkpoint_policies as _cp
+    _HAVE_POLICIES = hasattr(_cp, "save_only_these_names")
+except ImportError:       # ancient jax: plans degrade to all-KEEP
+    _cp = None
+    _HAVE_POLICIES = False
+
+
+def saved_names(model, plan: MemPlan) -> Tuple[str, ...]:
+    """checkpoint_name tags the policy saves: tagged outputs of every
+    KEPT layer (models/model.py tags linear/aggregate/gat outputs and the
+    layer boundary — see estimator.SAVED_KINDS)."""
+    kept = {i for i, d in enumerate(plan.decisions) if d == KEEP}
+    return tuple(op.attrs["ckpt"] for op in model.ops
+                 if op.attrs.get("layer") in kept
+                 and op.attrs.get("ckpt")
+                 and op.attrs.get("ckpt_save"))
+
+
+def checkpoint_policy(model, plan: Optional[MemPlan]):
+    """The jax.checkpoint policy for a plan; None = no wrap (all-KEEP)."""
+    if plan is None or not plan.any_remat() or not _HAVE_POLICIES:
+        return None
+    return _cp.save_only_these_names(*saved_names(model, plan))
+
+
+def loss_fn(model, plan: Optional[MemPlan]):
+    """A drop-in replacement for ``model.loss`` that applies the plan's
+    checkpoint policy around the forward pass.  Returns ``model.loss``
+    itself when the plan keeps everything, so default runs trace the
+    exact same program as before the planner existed."""
+    policy = checkpoint_policy(model, plan)
+    if policy is None:
+        return model.loss
+
+    def planned_loss(params, x, labels, mask, gctx, key=None, train=True):
+        # the one sanctioned raw-remat site (module docstring); prevent_cse
+        # stays on (default): under jit, XLA CSE would otherwise undo the
+        # rematerialization this plan was budgeted for
+        apply_ = jax.checkpoint(
+            lambda p, xx: model.apply(p, xx, gctx, key=key, train=train,
+                                      ckpt_names=True),
+            policy=policy)
+        logits = apply_(params, x)
+        return ops.masked_softmax_cross_entropy(logits, labels, mask)
+
+    return planned_loss

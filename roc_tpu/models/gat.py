@@ -36,5 +36,6 @@ def build_gat(layers: Sequence[int], dropout_rate: float = 0.5,
         t = model.gat(t, layers[i], heads=1 if last else heads, slope=slope)
         if not last:
             t = model.elu(t)
+        model.end_layer()
     model.softmax_cross_entropy(t)
     return model

@@ -39,5 +39,6 @@ def build_gcn(layers: Sequence[int], dropout_rate: float = 0.5,
         if len(layers) > 3:
             proj = model.linear(residual_in, t.dim)
             t = model.add(t, proj)
+        model.end_layer()
     model.softmax_cross_entropy(t)
     return model

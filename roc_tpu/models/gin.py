@@ -34,5 +34,6 @@ def build_gin(layers: Sequence[int], dropout_rate: float = 0.5) -> Model:
         t = model.indegree_norm(t)
         if i != len(layers) - 1:
             t = model.relu(t)
+        model.end_layer()
     model.softmax_cross_entropy(t)
     return model

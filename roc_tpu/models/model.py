@@ -26,6 +26,19 @@ import jax.numpy as jnp
 
 from roc_tpu import ops
 
+try:
+    from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
+except ImportError:  # pragma: no cover - ancient jax: tags degrade to id
+    def _checkpoint_name(x, name):
+        return x
+
+# Op kinds whose outputs a kept layer saves under an active memory plan
+# (roc_tpu/memory): expensive to recompute.  Elementwise outputs (dropout /
+# norm / activation / add) are never saved — recomputing them is
+# bandwidth-cheap (the per-tensor half of the planner's granularity
+# decision; see roc_tpu/memory/estimator.py).
+CKPT_SAVE_KINDS = frozenset({"linear", "aggregate", "gat"})
+
 
 class GraphCtx(NamedTuple):
     """Everything an op needs to know about the (shard of the) graph."""
@@ -62,6 +75,7 @@ class Model:
         self.logits: Optional[TensorRef] = None
         self.num_linear = 0
         self.num_dropout = 0
+        self._cur_layer = 0
 
     # -- builder API (names mirror the reference's Model methods) ---------
     def _new(self, dim: int) -> TensorRef:
@@ -69,31 +83,54 @@ class Model:
         self._next_id += 1
         return t
 
+    def _emit(self, op: OpNode) -> None:
+        """Append ``op``, stamping the memory planner's attrs: the current
+        layer index and a stable checkpoint name (derived from the op IR,
+        so a given builder config always yields the same name set)."""
+        op.attrs["layer"] = self._cur_layer
+        op.attrs["ckpt"] = f"L{self._cur_layer}.{op.kind}{op.out}"
+        op.attrs["ckpt_save"] = op.kind in CKPT_SAVE_KINDS
+        self.ops.append(op)
+
+    def end_layer(self) -> None:
+        """Close the current GNN layer: marks the last emitted op as the
+        layer boundary (always saved under an active plan — it is the next
+        layer's input) and starts the next layer index."""
+        if self.ops and self.ops[-1].attrs["layer"] == self._cur_layer:
+            self.ops[-1].attrs["ckpt_boundary"] = True
+            self.ops[-1].attrs["ckpt_save"] = True
+        self._cur_layer += 1
+
+    @property
+    def num_layers(self) -> int:
+        """Number of closed layers (builders call end_layer per GNN layer)."""
+        return max(self._cur_layer, 1)
+
     def dropout(self, t: TensorRef, rate: float) -> TensorRef:
         out = self._new(t.dim)
-        self.ops.append(OpNode("dropout", (t.id,), out.id,
-                               {"rate": rate, "slot": self.num_dropout}))
+        self._emit(OpNode("dropout", (t.id,), out.id,
+                          {"rate": rate, "slot": self.num_dropout}))
         self.num_dropout += 1
         return out
 
     def linear(self, t: TensorRef, out_dim: int,
                activation: str = "none") -> TensorRef:
         out = self._new(out_dim)
-        self.ops.append(OpNode("linear", (t.id,), out.id,
-                               {"in_dim": t.dim, "out_dim": out_dim,
-                                "activation": activation,
-                                "param": f"linear_{self.num_linear}"}))
+        self._emit(OpNode("linear", (t.id,), out.id,
+                          {"in_dim": t.dim, "out_dim": out_dim,
+                           "activation": activation,
+                           "param": f"linear_{self.num_linear}"}))
         self.num_linear += 1
         return out
 
     def indegree_norm(self, t: TensorRef) -> TensorRef:
         out = self._new(t.dim)
-        self.ops.append(OpNode("norm", (t.id,), out.id, {}))
+        self._emit(OpNode("norm", (t.id,), out.id, {}))
         return out
 
     def scatter_gather(self, t: TensorRef, aggr: str = "sum") -> TensorRef:
         out = self._new(t.dim)
-        self.ops.append(OpNode("aggregate", (t.id,), out.id, {"aggr": aggr}))
+        self._emit(OpNode("aggregate", (t.id,), out.id, {"aggr": aggr}))
         return out
 
     def gat(self, t: TensorRef, head_dim: int, heads: int = 1,
@@ -102,10 +139,10 @@ class Model:
         aggregation, heads concatenated).  Exercises the edge-tensor path
         the reference left latent (create_edge_tensor, gnn.cc:534-589)."""
         out = self._new(head_dim * heads)
-        self.ops.append(OpNode("gat", (t.id,), out.id,
-                               {"in_dim": t.dim, "head_dim": head_dim,
-                                "heads": heads, "slope": slope,
-                                "param": f"gat_{self.num_linear}"}))
+        self._emit(OpNode("gat", (t.id,), out.id,
+                          {"in_dim": t.dim, "head_dim": head_dim,
+                           "heads": heads, "slope": slope,
+                           "param": f"gat_{self.num_linear}"}))
         self.num_linear += 1
         return out
 
@@ -120,13 +157,13 @@ class Model:
 
     def _activation(self, t: TensorRef, mode: str) -> TensorRef:
         out = self._new(t.dim)
-        self.ops.append(OpNode("activation", (t.id,), out.id, {"mode": mode}))
+        self._emit(OpNode("activation", (t.id,), out.id, {"mode": mode}))
         return out
 
     def add(self, a: TensorRef, b: TensorRef) -> TensorRef:
         assert a.dim == b.dim
         out = self._new(a.dim)
-        self.ops.append(OpNode("add", (a.id, b.id), out.id, {}))
+        self._emit(OpNode("add", (a.id, b.id), out.id, {}))
         return out
 
     def softmax_cross_entropy(self, t: TensorRef) -> TensorRef:
@@ -164,8 +201,15 @@ class Model:
 
     # -- execution --------------------------------------------------------
     def apply(self, params: Dict[str, Any], x: jnp.ndarray, gctx: GraphCtx,
-              key=None, train: bool = False) -> jnp.ndarray:
-        """Run the op list; returns logits ([N_local, C])."""
+              key=None, train: bool = False,
+              ckpt_names: bool = False) -> jnp.ndarray:
+        """Run the op list; returns logits ([N_local, C]).
+
+        ``ckpt_names=True`` tags every op output with its stable
+        ``checkpoint_name`` so a surrounding ``jax.checkpoint`` with a
+        ``save_only_these_names`` policy (roc_tpu/memory/policy.py) can pick
+        residuals.  Off by default: untagged programs are byte-identical to
+        the pre-planner ones, which the HLO budget audit pins."""
         vals: Dict[int, jnp.ndarray] = {0: x}
         for op in self.ops:
             a = vals[op.inputs[0]]
@@ -198,6 +242,8 @@ class Model:
                 out = ops.add(a, vals[op.inputs[1]])
             else:
                 raise ValueError(f"unknown op kind {op.kind!r}")
+            if ckpt_names:
+                out = _checkpoint_name(out, op.attrs["ckpt"])
             vals[op.out] = out
         assert self.logits is not None, "call softmax_cross_entropy() last"
         return vals[self.logits.id]

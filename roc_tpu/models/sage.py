@@ -37,5 +37,6 @@ def build_sage(layers: Sequence[int], dropout_rate: float = 0.5,
         t = model.add(self_, neigh)
         if i != len(layers) - 1:
             t = model.relu(t)
+        model.end_layer()
     model.softmax_cross_entropy(t)
     return model

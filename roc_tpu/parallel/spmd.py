@@ -841,7 +841,8 @@ def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
     m0 = jax.lax.pcast(jnp.full((S, K), NEG), PARTS_AXIS, to="varying")
     z0 = jax.lax.pcast(jnp.zeros((S, K)), PARTS_AXIS, to="varying")
     u0 = jax.lax.pcast(jnp.zeros((S, K, F)), PARTS_AXIS, to="varying")
-    (_, _, z, u), _ = jax.lax.scan(
+    (_, _, z, u), _ = jax.lax.scan(  # ring-step remat keeps the rotating
+        # buffer out of the residual set  # roclint: allow(remat)
         jax.checkpoint(step, prevent_cse=False), (h, m0, z0, u0),
         jnp.arange(P_))
     # _Z_GUARD (ops/edge.py): big enough to survive BOTH the XLA
@@ -1493,6 +1494,10 @@ class SpmdTrainer(BaseTrainer):
                                      self._repl_spec)
         self.opt_state = jax.device_put(self.optimizer.init(self.params),
                                         self._repl_spec)
+        # Plan activation memory once per setup, before the steps trace:
+        # reshards keep the plan (the per-device shard shape is frozen), so
+        # the step cache below still hits on a same-structure rebuild.
+        self._resolve_mem_plan()
         self._build_steps(gd)
 
     def _place_data(self, gd: ShardedGraphData):
@@ -1541,7 +1546,9 @@ class SpmdTrainer(BaseTrainer):
         # keyed on the graph pytree's structure + leaf shapes/dtypes (the
         # static half of jax's own cache key).  This is what lets the
         # retrace guard (analysis/retrace.py) assert literal zero.
+        mem_plan = getattr(self, "mem_plan", None)
         sig = (S, exchange, k,
+               mem_plan.key() if mem_plan is not None else None,
                jax.tree_util.tree_structure(gd),
                tuple((tuple(leaf.shape), str(leaf.dtype))
                      for leaf in jax.tree_util.tree_leaves(gd)))
@@ -1564,10 +1571,14 @@ class SpmdTrainer(BaseTrainer):
                 return _shard_gctx_over(gd_block, S, k, exchange)
             return _shard_gctx(_squeeze_gd(gd_block), S, exchange)
 
+        # model.loss with the memory plan's checkpoint policy applied (the
+        # model's own loss under an all-KEEP plan — identical program)
+        loss_fn = self._loss_fn()
+
         def local_loss(params, x, labels, mask, gd_block, key):
             gctx = block_gctx(gd_block)
-            return model.loss(params, x, labels, mask, gctx, key=key,
-                              train=True)
+            return loss_fn(params, x, labels, mask, gctx, key=key,
+                           train=True)
 
         gd_specs = jax.tree.map(lambda a: P(PARTS_AXIS), gd)
 

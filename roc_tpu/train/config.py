@@ -25,6 +25,26 @@ import argparse
 import dataclasses
 from typing import List, Optional
 
+_SIZE_SUFFIX = {"k": 2 ** 10, "m": 2 ** 20, "g": 2 ** 30, "t": 2 ** 40}
+
+
+def parse_size(s: str) -> int:
+    """Byte-size spec with binary suffixes: '6g', '512m', '8589934592'.
+    Empty string means "no budget" (0).  SystemExit on malformed input so
+    CLI/env mistakes fail loudly, matching the balance env handling."""
+    s = (s or "").strip().lower()
+    if not s:
+        return 0
+    mult = 1
+    if s[-1] in _SIZE_SUFFIX:
+        mult = _SIZE_SUFFIX[s[-1]]
+        s = s[:-1]
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        raise SystemExit(f"bad byte-size spec {s!r} "
+                         "(want e.g. 6g, 512m, 8589934592)")
+
 
 @dataclasses.dataclass
 class Config:
@@ -105,6 +125,14 @@ class Config:
                                   # predicted max-part time drops by at
                                   # least this fraction
     balance_trace: str = ""       # JSONL telemetry trace path ("" = none)
+    mem_plan: str = "keep"        # activation-memory plan (roc_tpu/memory):
+                                  # keep (default; no remat — byte-identical
+                                  # to the pre-planner programs) | auto (DP
+                                  # under -mem-budget) | remat (every layer)
+    mem_budget: str = ""          # per-device HBM budget for -mem-plan auto
+                                  # (k/m/g/t suffixes; "" = the device's
+                                  # reported bytes_limit, or unbounded when
+                                  # the backend doesn't report one)
 
     def __post_init__(self):
         # ROC_BALANCE* env overrides so driverless entry points (bench.py,
@@ -121,6 +149,21 @@ class Config:
                              "must be numeric")
         if env.get("ROC_BALANCE_TRACE"):
             self.balance_trace = env["ROC_BALANCE_TRACE"]
+        # ROC_MEM_* mirror -mem-plan / -mem-budget for driverless entry
+        # points (bench.py, audit fixtures).
+        if env.get("ROC_MEM_PLAN"):
+            self.mem_plan = env["ROC_MEM_PLAN"]
+        if self.mem_plan not in ("keep", "auto", "remat"):
+            raise SystemExit(f"bad mem_plan {self.mem_plan!r} "
+                             "(keep|auto|remat)")
+        if env.get("ROC_MEM_BUDGET"):
+            self.mem_budget = env["ROC_MEM_BUDGET"]
+        parse_size(self.mem_budget)  # validate eagerly (SystemExit if bad)
+
+    def mem_budget_bytes(self) -> int:
+        """-mem-budget in bytes (0 = unset; driver falls back to the
+        device's reported HBM limit)."""
+        return parse_size(self.mem_budget)
 
     def exchange_mode(self) -> str:
         """Effective exchange mode ('halo' | 'allgather' | 'ring')."""
@@ -179,6 +222,11 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-balance-min-gain", dest="balance_min_gain", type=float,
                    default=0.05)
     p.add_argument("-balance-trace", dest="balance_trace", default="")
+    p.add_argument("-mem-plan", dest="mem_plan", default="keep",
+                   choices=["keep", "auto", "remat"])
+    p.add_argument("-mem-budget", dest="mem_budget", default="",
+                   help="per-device HBM budget for -mem-plan auto "
+                        "(e.g. 6g, 512m)")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

@@ -206,6 +206,11 @@ class TrainStats:
     epochs: int
     final_loss: float
     rebalance_events: list = dataclasses.field(default_factory=list)
+    # per-epoch peak HBM (bytes): device-reported where the backend exposes
+    # memory_stats (TPU), the memory planner's prediction elsewhere;
+    # ``peak_hbm_source`` says which ("measured" | "estimated" | "")
+    peak_hbm_bytes: list = dataclasses.field(default_factory=list)
+    peak_hbm_source: str = ""
 
 
 class BaseTrainer:
@@ -241,6 +246,41 @@ class BaseTrainer:
         """Can this trainer apply a repartition mid-run?  The SPMD trainer
         overrides this for the modes ``reshard`` handles."""
         return False
+
+    def _resolve_mem_plan(self):
+        """Choose this run's activation-memory plan (roc_tpu/memory) from
+        -mem-plan / -mem-budget.  Called once per _setup, before the steps
+        are traced; reshards keep the plan, so the step cache (keyed on
+        ``mem_plan.key()``) still hits."""
+        from roc_tpu import memory
+        cfg = self.config
+        self.mem_estimate = memory.estimate_for_trainer(self)
+        budget = cfg.mem_budget_bytes()
+        if cfg.mem_plan == "auto" and budget == 0:
+            budget = memory.device_budget_bytes()
+        self.mem_plan = memory.plan_memory(self.mem_estimate,
+                                           mode=cfg.mem_plan,
+                                           budget_bytes=budget)
+        if cfg.verbose and (cfg.mem_plan != "keep" or budget):
+            print(f"# {self.mem_plan.summary()}")
+
+    def _loss_fn(self):
+        """``model.loss`` with the memory plan's checkpoint policy applied
+        (the model's own loss when the plan keeps everything)."""
+        from roc_tpu.memory import policy as mem_policy
+        return mem_policy.loss_fn(self.model, getattr(self, "mem_plan", None))
+
+    def _peak_hbm(self):
+        """(bytes, source) for this epoch's peak HBM: device-reported where
+        the backend exposes memory_stats, the plan's prediction otherwise."""
+        from roc_tpu import memory
+        measured = memory.measured_peak_bytes()
+        if measured is not None:
+            return measured, "measured"
+        plan = getattr(self, "mem_plan", None)
+        if plan is not None:
+            return plan.predicted_peak_bytes, "estimated"
+        return 0, ""
 
     # subclasses: place data (x/labels/mask/gdata), init params/opt_state,
     # and build the jitted self._train_step / self._eval_step
@@ -335,6 +375,8 @@ class BaseTrainer:
         tracing = False
         loss = float("nan")
         rebalance_events = []
+        peak_hbm = []
+        peak_src = ""
         for epoch in range(start, start + cfg.num_epochs):
             if cfg.profile_dir and epoch == prof_start:
                 jax.profiler.start_trace(cfg.profile_dir)
@@ -345,9 +387,12 @@ class BaseTrainer:
             # reaches the host, not when dispatch returns
             device_sync(loss)  # roclint: allow(host-sync)
             self.epoch_times.append(time.perf_counter() - te)
+            hbm, peak_src = self._peak_hbm()
+            peak_hbm.append(hbm)
             if self.balancer is not None:
-                self.balancer.telemetry.record_epoch(epoch,
-                                                     self.epoch_times[-1])
+                self.balancer.telemetry.record_epoch(
+                    epoch, self.epoch_times[-1], peak_hbm=hbm,
+                    peak_hbm_source=peak_src)
             if tracing and epoch + 1 == prof_stop:
                 device_sync(self.params)
                 jax.profiler.stop_trace()
@@ -389,7 +434,8 @@ class BaseTrainer:
         return TrainStats(
             epoch_times=list(self.epoch_times), total_s=dt,
             epochs=cfg.num_epochs, final_loss=float(device_sync(loss)),
-            rebalance_events=rebalance_events)
+            rebalance_events=rebalance_events,
+            peak_hbm_bytes=peak_hbm, peak_hbm_source=peak_src)
 
     # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
     def save_checkpoint(self, path: str, extra=None):
@@ -428,12 +474,14 @@ class Trainer(BaseTrainer):
         self.opt_state = self.optimizer.init(self.params)
         self.num_nodes = ds.graph.num_nodes
         n = self.num_nodes
+        self._resolve_mem_plan()
+        loss_fn = self._loss_fn()
 
         @jax.jit
         def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
             _retrace.note_trace("train_step")
             gctx = make_gctx(gdata, n)
-            loss, grads = jax.value_and_grad(model.loss)(
+            loss, grads = jax.value_and_grad(loss_fn)(
                 params, x, labels, mask, gctx, key=key, train=True)
             params, opt_state = self.optimizer.update(
                 params, grads, opt_state, alpha)

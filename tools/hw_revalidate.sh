@@ -74,10 +74,16 @@ note "3a. fp32-exact epoch on the binned kernels (target: <= 1.0 s)"
 ROC_BENCH_PRECISION=exact ROC_BENCH_BACKEND=binned ROC_BENCH_EPOCHS=5 \
     timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 
-note "3b. GAT epoch, plan-backend attention (target: within ~2x of GCN)"
-ROC_BENCH_MODEL=gat ROC_BENCH_LAYERS=602-64-41 ROC_BENCH_HEADS=4 \
-    ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
-    | tail -2 | tee -a "$LOG"
+note "3b. GAT shape sweep, plan-backend attention (target: within ~2x of"
+note "    GCN at the canonical shape; record each leg's roofline_frac in"
+note "    docs/PERF.md — the sweep shows where the attention path falls"
+note "    off the roofline as width/depth grow)"
+for gat_shape in 602-64-41 602-128-41 602-64-64-41; do
+    note "   ROC_BENCH_LAYERS=$gat_shape"
+    ROC_BENCH_MODEL=gat ROC_BENCH_LAYERS=$gat_shape ROC_BENCH_HEADS=4 \
+        ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+        | tail -2 | tee -a "$LOG"
+done
 
 note "3c. overcommit: 4 parts on the 1 bench chip (multi-part paths:"
 note "    halo all_to_all, per-part plans, psum)"
@@ -94,6 +100,16 @@ note "    load balancer (probe -> fit -> reshard under frozen shapes;"
 note "    expect 'balance@' lines, reshard only if pred gain >= 5%)"
 timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 8 -parts 4 -balance-every 2 -v 2>&1 | tail -4 | tee -a "$LOG"
+
+note "3e. memory-plan dryrun (roc_tpu/memory): DP under a deliberately"
+note "    tight budget — expect a 'mem-plan[auto/dp]' line with >=1 remat"
+note "    layer, and the bench artifact's memory block comparing predicted"
+note "    vs measured (memory_stats) peak HBM"
+ROC_BENCH_MEM=1 ROC_MEM_PLAN=auto ROC_MEM_BUDGET=4g ROC_BENCH_EPOCHS=5 \
+    timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -mem-plan auto -mem-budget 2g -v 2>&1 \
+    | tail -3 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 4 ]; then

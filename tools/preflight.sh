@@ -28,6 +28,21 @@ echo "== budget audit =="
 timeout -k 10 600 python tools/roclint.py --audit --no-lint || {
     echo "preflight: collective budget audit RED" >&2; exit 1; }
 
+# Memory-plan determinism gate: the same config must produce a
+# byte-identical plan JSON (the plan participates in the step cache key —
+# nondeterminism here means phantom retraces and unreproducible OOM
+# triage).  Pure analytic path (no jax arrays), so this costs ~a second.
+echo "== memory-plan determinism =="
+PLAN_A=$(mktemp) PLAN_B=$(mktemp)
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m roc_tpu.memory --mode auto --budget 6g > "$PLAN_A" && \
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m roc_tpu.memory --mode auto --budget 6g > "$PLAN_B" && \
+cmp -s "$PLAN_A" "$PLAN_B" || {
+    echo "preflight: memory plan JSON not deterministic" >&2
+    diff "$PLAN_A" "$PLAN_B" >&2; rm -f "$PLAN_A" "$PLAN_B"; exit 1; }
+rm -f "$PLAN_A" "$PLAN_B"
+
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
