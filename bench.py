@@ -523,6 +523,10 @@ def run():
         "epoch_s_max": round(max(times), 4),
         "epoch_times": [round(t, 4) for t in times],
     }
+    if os.environ.get("ROC_BINNED_FLAT") == "1":
+        # flat-schedule A/B leg (spmd honors the same env when building
+        # shard plans) — stamp it so paired artifacts are distinguishable
+        result["binned_flat"] = True
     if fallback_from is not None:
         result["fallback"] = f"auto failed ({fallback_from}); ran {fb}"
     if ANALYZE:
@@ -580,7 +584,7 @@ def run():
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0
-            and MEM_PLAN == "keep"
+            and MEM_PLAN == "keep" and "binned_flat" not in result
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

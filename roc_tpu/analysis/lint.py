@@ -363,6 +363,20 @@ class _FileLint:
                 free = {n.id for n in ast.walk(node)
                         if isinstance(n, ast.Name)
                         and isinstance(n.ctx, ast.Load)} - bound
+                # decorator expressions evaluate at def time, not call
+                # time — a loop variable there is bound immediately
+                # (e.g. @pl.when(c == i)), so it is not a late capture
+                if not isinstance(node, ast.Lambda):
+                    deco_names = set()
+                    for deco in node.decorator_list:
+                        deco_names |= {n.id for n in ast.walk(deco)
+                                       if isinstance(n, ast.Name)}
+                    body_names = set()
+                    for part in node.body:
+                        body_names |= {n.id for n in ast.walk(part)
+                                       if isinstance(n, ast.Name)
+                                       and isinstance(n.ctx, ast.Load)}
+                    free -= deco_names - body_names
                 captured = free & targets
                 if captured:
                     self._flag(node, "closure-capture",

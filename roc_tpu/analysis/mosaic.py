@@ -1,0 +1,188 @@
+"""Static Mosaic-alignment lint for Pallas kernels (rule: mosaic-align).
+
+Mosaic tiles fp32 VMEM as (8, 128): a DMA slice or BlockSpec window whose
+lane (last) dimension is not a multiple of 128, or whose sublane
+(second-to-last) dimension is not a multiple of 8, lowers fine in
+interpret mode and then hard-errors (or silently pads) on hardware —
+the class behind both interpret-only escapes that cost hardware windows
+(the H=41 slot DMA and the 1-row HBM gather, docs/PERF.md).  This pass
+walks ``pl.ds``/``pl.dslice`` slice sizes and ``pl.BlockSpec`` shape
+tuples offline and flags provably-misaligned ones.
+
+Resolution is deliberately conservative — zero false positives on the
+shipped kernels is a pinned test (test_mosaic_lint_clean_on_tree):
+
+* Only module-level ``NAME = <int>`` constants and integer literals
+  resolve; runtime values (geometry fields, feature widths) don't, and
+  unresolvable dims are skipped, not flagged.
+* A ``a * b`` size passes if EITHER factor is provably a multiple of the
+  requirement (``csz * _UNIT`` with ``_UNIT = 8`` is aligned for any
+  csz).
+* ``BlockSpec`` shapes with ``memory_space=...SMEM`` are exempt (scalar
+  metadata blocks aren't tiled), as is a lane dimension of exactly 1
+  (the (N, 1) int32 indicator-column layout Mosaic handles specially).
+
+Waive a finding with ``# roclint: allow(mosaic-align)`` on the offending
+or preceding line, same as every other roclint rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional
+
+from roc_tpu.analysis.lint import Finding, _WAIVER_RE, _dotted
+
+RULE = "mosaic-align"
+_DS_HEADS = {"pl.ds", "pl.dslice", "pltpu.ds", "pallas.ds"}
+_SPEC_HEADS = {"pl.BlockSpec", "pallas.BlockSpec", "pltpu.BlockSpec"}
+LANE, SUBLANE = 128, 8
+
+
+def _module_int_consts(tree: ast.Module) -> Dict[str, int]:
+    """Top-level NAME = <int literal> bindings (incl. tuple unpacking)."""
+    consts: Dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, int):
+                consts[tgt.id] = node.value.value
+            elif isinstance(tgt, ast.Tuple) and \
+                    isinstance(node.value, ast.Tuple) and \
+                    len(tgt.elts) == len(node.value.elts):
+                for tn, tv in zip(tgt.elts, node.value.elts):
+                    if isinstance(tn, ast.Name) and \
+                            isinstance(tv, ast.Constant) and \
+                            isinstance(tv.value, int):
+                        consts[tn.id] = tv.value
+    return consts
+
+
+def _resolve(node, consts: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        a = _resolve(node.left, consts)
+        b = _resolve(node.right, consts)
+        if a is not None and b is not None:
+            return a * b
+    return None
+
+
+def _aligned(node, m: int, consts: Dict[str, int]) -> Optional[bool]:
+    """True/False when alignment to ``m`` is provable; None = unknown."""
+    v = _resolve(node, consts)
+    if v is not None:
+        return v % m == 0
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        # a multiple-of-m factor makes the whole product aligned
+        for side in (node.left, node.right):
+            if _aligned(side, m, consts):
+                return True
+    return None
+
+
+def _is_smem_spec(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "memory_space":
+            return (_dotted(kw.value) or "").endswith("SMEM")
+    return False
+
+
+class _MosaicLint:
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src_lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.consts = _module_int_consts(self.tree)
+        self.findings: List[Finding] = []
+
+    def _flag(self, node, msg: str):
+        line = getattr(node, "lineno", 1)
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.src_lines):
+                m = _WAIVER_RE.search(self.src_lines[ln - 1])
+                if m and RULE in [r.strip() for r in m.group(1).split(",")]:
+                    return
+        self.findings.append(Finding(self.path, line, RULE, msg))
+
+    def run(self) -> List[Finding]:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            head = _dotted(node.func) or ""
+            if head in _DS_HEADS:
+                self._check_ds(node)
+            elif head in _SPEC_HEADS:
+                self._check_spec(node)
+        return self.findings
+
+    def _check_ds(self, call: ast.Call):
+        if len(call.args) < 2:      # pl.ds(start) has implicit size 1:
+            return                  # axis-dependent, can't judge statically
+        size = call.args[1]
+        ok = _aligned(size, SUBLANE, self.consts)
+        if ok is False:
+            v = _resolve(size, self.consts)
+            self._flag(call,
+                       f"pl.ds slice size {v} is not a multiple of "
+                       f"{SUBLANE} — Mosaic sublane tiling rejects this "
+                       f"DMA on hardware (interpret mode hides it)")
+
+    def _check_spec(self, call: ast.Call):
+        if not call.args or not isinstance(call.args[0], ast.Tuple):
+            return
+        if _is_smem_spec(call):
+            return
+        dims = call.args[0].elts
+        if not dims:
+            return
+        lane = _resolve(dims[-1], self.consts)
+        if lane == 1:
+            return          # (N, 1) indicator-column layout
+        if lane is not None and lane % LANE:
+            self._flag(call,
+                       f"BlockSpec lane dimension {lane} is not a "
+                       f"multiple of {LANE} — pad the feature axis "
+                       f"(interpret mode hides the hardware error)")
+        if len(dims) >= 2:
+            sub = _aligned(dims[-2], SUBLANE, self.consts)
+            if sub is False:
+                v = _resolve(dims[-2], self.consts)
+                self._flag(call,
+                           f"BlockSpec sublane dimension {v} is not a "
+                           f"multiple of {SUBLANE} — Mosaic tiling "
+                           f"rejects this window on hardware")
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    if "pallas" not in src:     # cheap gate: nothing to check
+        return []
+    return _MosaicLint(path, src).run()
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths) -> List[Finding]:
+    out: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.extend(lint_file(os.path.join(root, fn)))
+        elif p.endswith(".py"):
+            out.extend(lint_file(p))
+    return sorted(out, key=lambda f: (f.path, f.line))

@@ -104,6 +104,12 @@ def _bind(L: ctypes.CDLL) -> None:
     L.roc_binned_plan_fill_g.argtypes = [i64p, i64p, i64p] + \
         [ctypes.c_int64] * 7 + [i32p] * 6
     L.roc_binned_plan_fill_g.restype = ctypes.c_int
+    L.roc_binned_flat_plan_sizes_g.argtypes = [i64p, i64p, i64p] + \
+        [ctypes.c_int64] * 4 + [i64p]
+    L.roc_binned_flat_plan_sizes_g.restype = ctypes.c_int
+    L.roc_binned_flat_plan_fill_g.argtypes = [i64p, i64p, i64p] + \
+        [ctypes.c_int64] * 7 + [i32p] * 8
+    L.roc_binned_flat_plan_fill_g.restype = ctypes.c_int
     L.roc_rcm_order.argtypes = [i64p, i32p, i64p, i32p, ctypes.c_int64,
                                 i64p]
     L.roc_rcm_order.restype = ctypes.c_int
@@ -300,6 +306,50 @@ def binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray, num_rows: int,
         raise RuntimeError(f"roc_binned_plan_fill rc={rc}")
     return (p1_srcl.reshape(G, C1 * CH), p1_off.reshape(G, C1, NSLOT),
             p1_blk.reshape(G, C1), p2_dstl.reshape(G, C2 * CH2),
+            p2_obi.reshape(G, C2), p2_first.reshape(G, C2), bpg)
+
+
+def binned_flat_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
+                     num_rows: int, table_rows: int, group_row_target: int,
+                     geom):
+    """Flat-schedule binned plan (see binned._build_flat_plan_numpy).
+
+    Returns (p1_srcl [G,C1*CH], p1_blk [G,C1], p1_blk2 [G,C1],
+    p1_dsrc [G,C1,KD], p1_ddst [G,C1,KD], p2_dstl [G,C2*CH2],
+    p2_obi [G,C2], p2_first [G,C2], bins_per_group) int32 arrays matching
+    the pure-NumPy flat builder bit for bit
+    (test_native_flat_plan_equals_numpy)."""
+    L = lib()
+    assert L is not None
+    CH, CH2, KD = geom.ch, geom.ch2, geom.kd
+    geo5 = np.asarray(tuple(geom)[:5], np.int64)
+    src = np.ascontiguousarray(edge_src, np.int64)
+    dst = np.ascontiguousarray(edge_dst, np.int64)
+    E = len(src)
+    out4 = np.zeros(4, np.int64)
+    rc = L.roc_binned_flat_plan_sizes_g(geo5, src, dst, E, num_rows,
+                                        table_rows, group_row_target, out4)
+    if rc != 0:
+        raise RuntimeError(f"roc_binned_flat_plan_sizes rc={rc}")
+    G, C1, C2, bpg = (int(v) for v in out4)
+    p1_srcl = np.empty(G * C1 * CH, np.int32)
+    p1_blk = np.empty(G * C1, np.int32)
+    p1_blk2 = np.empty(G * C1, np.int32)
+    p1_dsrc = np.empty(G * C1 * KD, np.int32)
+    p1_ddst = np.empty(G * C1 * KD, np.int32)
+    p2_dstl = np.empty(G * C2 * CH2, np.int32)
+    p2_obi = np.empty(G * C2, np.int32)
+    p2_first = np.empty(G * C2, np.int32)
+    rc = L.roc_binned_flat_plan_fill_g(geo5, src, dst, E, num_rows,
+                                       table_rows, group_row_target, G, C1,
+                                       C2, p1_srcl, p1_blk, p1_blk2,
+                                       p1_dsrc, p1_ddst, p2_dstl, p2_obi,
+                                       p2_first)
+    if rc != 0:
+        raise RuntimeError(f"roc_binned_flat_plan_fill rc={rc}")
+    return (p1_srcl.reshape(G, C1 * CH), p1_blk.reshape(G, C1),
+            p1_blk2.reshape(G, C1), p1_dsrc.reshape(G, C1, KD),
+            p1_ddst.reshape(G, C1, KD), p2_dstl.reshape(G, C2 * CH2),
             p2_obi.reshape(G, C2), p2_first.reshape(G, C2), bpg)
 
 

@@ -603,6 +603,274 @@ int roc_binned_plan_fill(const int64_t* src, const int64_t* dst, int64_t E,
                                 p2_first);
 }
 
+// ---------------------------------------------------------------------------
+// Flat-schedule binned plan (binned.py _build_flat_plan_numpy mirror).
+// Cells pad to BN_UNIT(=8)-row units; each group's per-block unit streams
+// pack back-to-back into CH-row chunks (a chunk may span at most TWO
+// blocks — early cut when a third would enter a partly-filled chunk); the
+// slot-offset table becomes per-chunk run lists of size-classed staging
+// copies (128/32/8 rows), KD = CH/8 entries max per chunk.  Phase 2 keeps
+// the slot builder's layout with units instead of slots.  Must stay
+// element-identical to the NumPy builder (test_native_flat_plan_equals_numpy).
+// ---------------------------------------------------------------------------
+
+static const int64_t BN_UNIT = 8;                      // binned.py _UNIT
+static const int64_t BN_DMA_CLS[3] = {16, 4, 1};       // binned.py _DMA_CLS
+
+struct BnFlatGeo {
+  int64_t sb, ch, rb, ch2, uc, u2, kd;
+};
+
+static int bn_flat_geo_from(const int64_t* geo5, BnFlatGeo* g) {
+  g->sb = geo5[0]; g->ch = geo5[1]; g->rb = geo5[3]; g->ch2 = geo5[4];
+  if (g->sb < 1 || g->rb < 1) return -1;
+  if (g->ch < BN_UNIT || g->ch % BN_UNIT) return -1;
+  if (g->ch2 < BN_UNIT || g->ch2 % BN_UNIT) return -1;
+  g->uc = g->ch / BN_UNIT;
+  g->u2 = g->ch2 / BN_UNIT;
+  g->kd = g->ch / BN_UNIT;
+  return 0;
+}
+
+static int bn_flat_build(const BnFlatGeo& geo, const int64_t* src,
+                         const int64_t* dst, int64_t E, int64_t num_rows,
+                         int64_t table_rows, int64_t group_row_target,
+                         int64_t* out_G, int64_t* out_C1, int64_t* out_C2,
+                         int64_t* out_bpg, int64_t C1, int64_t C2,
+                         int32_t* p1_srcl, int32_t* p1_blk,
+                         int32_t* p1_blk2, int32_t* p1_dsrc,
+                         int32_t* p1_ddst, int32_t* p2_dstl,
+                         int32_t* p2_obi, int32_t* p2_first) {
+  const int64_t U = BN_UNIT;
+  BnGeo pgeo;  // bn_params only reads sb/rb
+  pgeo.sb = geo.sb; pgeo.rb = geo.rb;
+  int64_t num_bins, num_blocks, bpg, G;
+  bn_params(pgeo, E, num_rows, table_rows, group_row_target,
+            &num_bins, &num_blocks, &bpg, &G);
+  const bool fill = p1_srcl != nullptr;
+  const int64_t rows_pg = geo.rb * bpg;
+
+  // Pass 0: bucket edge values by group (same as the slot builder).
+  std::vector<int64_t> gcnt(G + 1, 0);
+  for (int64_t e = 0; e < E; e++) gcnt[dst[e] / rows_pg + 1]++;
+  for (int64_t g = 0; g < G; g++) gcnt[g + 1] += gcnt[g];
+  std::vector<int64_t> gsrc(E), gdst(E), gpos(gcnt.begin(), gcnt.end() - 1);
+  for (int64_t e = 0; e < E; e++) {
+    const int64_t p = gpos[dst[e] / rows_pg]++;
+    gsrc[p] = src[e];
+    gdst[p] = dst[e];
+  }
+
+  const int64_t K2 = num_blocks * bpg;
+  std::vector<int64_t> ccnt(K2, 0), cbase(K2), pos(K2);
+  std::vector<int64_t> bin_units(bpg), bin_cbase(bpg), bin_offu(bpg);
+  std::vector<int64_t> csrc, cdst;
+  if (fill) { csrc.resize(E); cdst.resize(E); }
+  int64_t maxC1 = 1, maxC2 = 1;
+
+  for (int64_t g = 0; g < G; g++) {
+    const int64_t lo = gcnt[g], hi = gcnt[g + 1];
+    if (g > 0) {
+      const int64_t plo = gcnt[g - 1], phi = gcnt[g];
+      for (int64_t i = plo; i < phi; i++)
+        ccnt[(gsrc[i] / geo.sb) * bpg
+             + (gdst[i] / geo.rb - (g - 1) * bpg)] = 0;
+    }
+    for (int64_t i = lo; i < hi; i++)
+      ccnt[(gsrc[i] / geo.sb) * bpg + (gdst[i] / geo.rb - g * bpg)]++;
+
+    // Phase-2 geometry: per-bin unit totals -> CH2-aligned chunk bases
+    // (empty bins still cost one chunk, mirroring the slot builder).
+    std::fill(bin_units.begin(), bin_units.end(), 0);
+    for (int64_t k = 0; k < K2; k++)
+      if (ccnt[k]) bin_units[k % bpg] += (ccnt[k] + U - 1) / U;
+    int64_t c2 = 0;
+    for (int64_t b = 0; b < bpg; b++) {
+      bin_cbase[b] = c2;
+      int64_t ch = (bin_units[b] + geo.u2 - 1) / geo.u2;
+      c2 += ch < 1 ? 1 : ch;
+    }
+    if (c2 > maxC2) maxC2 = c2;
+
+    // Phase-1 flat pack (unit-level replay of binned.py _flat_pack):
+    // walk cells in (blk, lbin) order; a blk change starts a new stream.
+    int64_t chunk = 0, fillu = 0, nblk = 0, cur_blk = -1;
+    bool newspan = false;
+    // run state (staging-copy run list; only used when filling)
+    int64_t run_chunk = -1, run_pos0 = 0, run_stg0 = 0, run_len = 0;
+    int64_t prev_stg = -2, ecur_chunk = -1, ecount = 0;
+    int32_t* srcl = fill ? p1_srcl + g * C1 * geo.ch : nullptr;
+    int32_t* blkp = fill ? p1_blk + g * C1 : nullptr;
+    int32_t* blk2p = fill ? p1_blk2 + g * C1 : nullptr;
+    int32_t* dsrcp = fill ? p1_dsrc + g * C1 * geo.kd : nullptr;
+    int32_t* ddstp = fill ? p1_ddst + g * C1 * geo.kd : nullptr;
+    int32_t* dstl = fill ? p2_dstl + g * C2 * geo.ch2 : nullptr;
+    bool overflow = false;
+    auto flush_run = [&]() {
+      if (run_len <= 0) return;
+      if (run_chunk != ecur_chunk) { ecur_chunk = run_chunk; ecount = 0; }
+      int64_t off = 0;
+      for (int ci = 0; ci < 3; ci++) {
+        const int64_t csz = BN_DMA_CLS[ci];
+        while (run_len - off >= csz) {
+          if (ecount >= geo.kd) { overflow = true; return; }
+          dsrcp[run_chunk * geo.kd + ecount] =
+              (int32_t)(ci * 65536 + run_pos0 + off);
+          ddstp[run_chunk * geo.kd + ecount] =
+              (int32_t)(run_stg0 + off);
+          ecount++;
+          off += csz;
+        }
+      }
+      run_len = 0;
+    };
+
+    if (fill) {
+      if (c2 > C2) return -1;
+      // Cell-order the group's edges (stable counting sort by k2).
+      cbase[0] = 0;
+      for (int64_t k = 1; k < K2; k++) cbase[k] = cbase[k - 1] + ccnt[k - 1];
+      std::copy(cbase.begin(), cbase.end(), pos.begin());
+      for (int64_t i = lo; i < hi; i++) {
+        const int64_t p = lo + pos[(gsrc[i] / geo.sb) * bpg
+                                   + (gdst[i] / geo.rb - g * bpg)]++;
+        csrc[p] = gsrc[i];
+        cdst[p] = gdst[i];
+      }
+    }
+    std::fill(bin_offu.begin(), bin_offu.end(), 0);
+    for (int64_t k = 0; k < K2; k++) {
+      const int64_t cnt = ccnt[k];
+      if (!cnt) continue;
+      const int64_t blk = k / bpg, lbin = k % bpg;
+      const int64_t units = (cnt + U - 1) / U;
+      if (blk != cur_blk) {                       // stream start
+        cur_blk = blk;
+        if (nblk >= 2 && fillu > 0) { chunk++; fillu = 0; nblk = 0; }
+        newspan = true;
+      }
+      const int64_t stg_unit0 = bin_cbase[lbin] * geo.u2 + bin_offu[lbin];
+      const int64_t cello = fill ? lo + cbase[k] : 0;
+      for (int64_t j = 0; j < units; j++) {
+        if (fillu == geo.uc) { chunk++; fillu = 0; nblk = 0; newspan = true; }
+        if (newspan) {
+          nblk++;
+          newspan = false;
+          if (fill && chunk < C1) {
+            if (fillu == 0) {                     // open span: primary blk
+              blkp[chunk] = (int32_t)blk;
+              blk2p[chunk] = (int32_t)blk;
+            } else {                              // tail span: secondary
+              blk2p[chunk] = (int32_t)blk;
+            }
+          }
+        }
+        if (fill) {
+          if (chunk >= C1) return -1;
+          const int64_t stg = stg_unit0 + j;
+          if (chunk != run_chunk || stg != prev_stg + 1) {
+            flush_run();
+            if (overflow) return -3;
+            run_chunk = chunk;
+            run_pos0 = fillu;
+            run_stg0 = stg;
+          }
+          run_len++;
+          prev_stg = stg;
+          const int64_t r0 = j * U;
+          const int64_t r1 = r0 + U < cnt ? r0 + U : cnt;
+          const int64_t row = chunk * geo.ch + fillu * U;
+          const int64_t sec = blkp[chunk] != (int32_t)blk ? geo.sb : 0;
+          for (int64_t r = r0; r < r1; r++)
+            srcl[row + (r - r0)] =
+                (int32_t)(csrc[cello + r] - blk * geo.sb + sec);
+        }
+        fillu++;
+      }
+      if (fill) {
+        const int64_t stg_row = stg_unit0 * U;
+        const int64_t boff = (g * bpg + lbin) * geo.rb;
+        for (int64_t r = 0; r < cnt; r++)
+          dstl[stg_row + r] = (int32_t)(cdst[cello + r] - boff);
+      }
+      bin_offu[lbin] += units;
+    }
+    if (fill) {
+      flush_run();
+      if (overflow) return -3;
+    }
+    const int64_t c1 = chunk + (fillu > 0 ? 1 : 0);
+    if (c1 > maxC1) maxC1 = c1;
+    if (fill && c1 > C1) return -1;
+
+    if (fill) {
+      int32_t* obi = p2_obi + g * C2;
+      int32_t* first = p2_first + g * C2;
+      int64_t c = 0;
+      for (int64_t b = 0; b < bpg; b++) {
+        int64_t ch = (bin_units[b] + geo.u2 - 1) / geo.u2;
+        if (ch < 1) ch = 1;
+        for (int64_t j = 0; j < ch; j++, c++) {
+          obi[c] = (int32_t)b;
+          first[c] = j == 0;
+        }
+      }
+      for (; c < C2; c++) { obi[c] = (int32_t)(bpg - 1); first[c] = 0; }
+    }
+  }
+  *out_G = G;
+  *out_C1 = (maxC1 + 7) / 8 * 8;
+  *out_C2 = maxC2;
+  *out_bpg = bpg;
+  return 0;
+}
+
+int roc_binned_flat_plan_sizes_g(const int64_t* geo5, const int64_t* src,
+                                 const int64_t* dst, int64_t E,
+                                 int64_t num_rows, int64_t table_rows,
+                                 int64_t group_row_target, int64_t* out4) {
+  BnFlatGeo geo;
+  if (bn_flat_geo_from(geo5, &geo) != 0) return -2;
+  return bn_flat_build(geo, src, dst, E, num_rows, table_rows,
+                       group_row_target, &out4[0], &out4[1], &out4[2],
+                       &out4[3], 0, 0, nullptr, nullptr, nullptr, nullptr,
+                       nullptr, nullptr, nullptr, nullptr);
+}
+
+// Caller allocates: p1_srcl [G*C1*CH], p1_blk [G*C1], p1_blk2 [G*C1],
+// p1_dsrc [G*C1*KD], p1_ddst [G*C1*KD] (KD = CH/8), p2_dstl [G*C2*CH2],
+// p2_obi [G*C2], p2_first [G*C2].  This call pre-fills the pad values
+// (srcl/dsrc/ddst -1, blk/blk2 0, dstl RB).  Returns 0, -1 on geometry
+// mismatch, -2 on invalid geometry, -3 on run-list overflow.
+int roc_binned_flat_plan_fill_g(const int64_t* geo5, const int64_t* src,
+                                const int64_t* dst, int64_t E,
+                                int64_t num_rows, int64_t table_rows,
+                                int64_t group_row_target, int64_t G,
+                                int64_t C1, int64_t C2, int32_t* p1_srcl,
+                                int32_t* p1_blk, int32_t* p1_blk2,
+                                int32_t* p1_dsrc, int32_t* p1_ddst,
+                                int32_t* p2_dstl, int32_t* p2_obi,
+                                int32_t* p2_first) {
+  BnFlatGeo geo;
+  if (bn_flat_geo_from(geo5, &geo) != 0) return -2;
+  std::fill(p1_srcl, p1_srcl + G * C1 * geo.ch, -1);
+  std::fill(p1_blk, p1_blk + G * C1, 0);
+  std::fill(p1_blk2, p1_blk2 + G * C1, 0);
+  std::fill(p1_dsrc, p1_dsrc + G * C1 * geo.kd, -1);
+  std::fill(p1_ddst, p1_ddst + G * C1 * geo.kd, -1);
+  std::fill(p2_dstl, p2_dstl + G * C2 * geo.ch2, (int32_t)geo.rb);
+  std::fill(p2_obi, p2_obi + G * C2, 0);
+  std::fill(p2_first, p2_first + G * C2, 0);
+  int64_t g2, c1, c2, bpg;
+  int rc = bn_flat_build(geo, src, dst, E, num_rows, table_rows,
+                         group_row_target, &g2, &c1, &c2, &bpg, C1, C2,
+                         p1_srcl, p1_blk, p1_blk2, p1_dsrc, p1_ddst,
+                         p2_dstl, p2_obi, p2_first);
+  if (rc != 0) return rc;
+  if (g2 != G || c1 > C1 || c2 > C2) return -1;
+  return 0;
+}
+
 void roc_in_degrees(const uint64_t* raw_rows, uint64_t num_nodes,
                     float* deg_out) {
   for (uint64_t v = 0; v < num_nodes; v++)

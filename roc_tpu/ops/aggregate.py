@@ -349,9 +349,14 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
     A forward geometry with ``hub_minc`` set (choose_geometry's hybrid
     verdict, or an explicit caller) splits the edges: the binned pair
     covers only the dense-cell edges and ``mm`` carries the rest."""
-    from roc_tpu.ops.pallas.binned import (_default_geom, build_binned_plan,
+    from roc_tpu.ops.pallas.binned import (Geometry, _default_geom,
+                                           build_binned_plan,
                                            choose_geometry, split_hub_edges)
-    fwd_spec, bwd_spec = geom if isinstance(geom, tuple) else (geom, geom)
+    # Geometry is itself a NamedTuple: only a PLAIN pair is (fwd, bwd)
+    if isinstance(geom, tuple) and not isinstance(geom, Geometry):
+        fwd_spec, bwd_spec = geom
+    else:
+        fwd_spec, bwd_spec = geom, geom
 
     def pick(spec, src, dst, n, t):
         if spec != "auto":
@@ -405,6 +410,7 @@ def pad_binned_plans(plans: "list[BinnedPlans]", min_fwd=(0, 0),
         "hybrid (binned+matmul) plans are single-device only"
 
     def stack(side, floors):
+        from roc_tpu.ops.pallas.binned import _PLAN_DATA_FIELDS
         ps = [getattr(b, side) for b in plans]
         meta = {(p.num_rows, p.table_rows, p.bins_per_group,
                  p.p1_blk.shape[0], p.geom) for p in ps}
@@ -413,9 +419,20 @@ def pad_binned_plans(plans: "list[BinnedPlans]", min_fwd=(0, 0),
         C2 = max(max(p.p2_obi.shape[1] for p in ps), floors[1])
         padded = [pad_binned_plan(p, C1, C2) for p in ps]
         import dataclasses as _dc
-        arrays = {f: jnp.stack([getattr(p, f) for p in padded])
-                  for f in ("p1_srcl", "p1_off", "p1_blk",
-                            "p2_dstl", "p2_obi", "p2_first")}
+        # The fused (f_*) schedules are a single-device fast path: their
+        # step lists bake in the per-shard chunk counts, which diverge
+        # under shard_map's one static program — strip them so the
+        # stacked plans take the two-pass scan uniformly.
+        arrays = {}
+        for f in _PLAN_DATA_FIELDS:
+            vals = [getattr(p, f) for p in padded]
+            if f.startswith("f_"):
+                arrays[f] = None
+                continue
+            present = [v is not None for v in vals]
+            assert all(present) or not any(present), \
+                f"shards disagree on plan field {f}"
+            arrays[f] = jnp.stack(vals) if all(present) else None
         return _dc.replace(padded[0], **arrays)
 
     return BinnedPlans(fwd=stack("fwd", min_fwd), bwd=stack("bwd", min_bwd))

@@ -63,6 +63,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import warnings
 from functools import partial
 
 import jax
@@ -83,6 +84,25 @@ CH2 = 4096    # staging rows per phase-2 chunk
 # (nslot/slot2 derive on Geometry below — every consumer rebinds from the
 # plan's geometry, so no module-level derived constants exist to go stale
 # under tools/sweep_binned.py's monkeypatching of the five above)
+
+# Flat-schedule staging granularity, rows.  One fp32 sublane tile is
+# (8, 128), so 8-row cell padding is the finest the DMA engine can move
+# without tearing tiles — and it is what gets pad1 under 1.05 at Reddit
+# shape (avg cell ~113 edges: 8-row padding wastes ~3.3%, SLOT=128 wastes
+# 43%).  Flat staging is therefore ALWAYS fp32: a bf16 tile is (16, 128)
+# and an 8-row slice of it is sublane-misaligned.
+_UNIT = 8
+# Staging-copy size classes for the flat schedule, in _UNIT-row units:
+# each per-(chunk, staging) run of consecutive rows decomposes greedily
+# into 128/32/8-row DMAs, so a dense cell still moves in few descriptors
+# while an 8-row tail costs exactly one.
+_DMA_CLS = (16, 4, 1)
+# Build-time ceiling on a group's staging rows for storing a fused
+# (phase-1/phase-2 interleaved) schedule on the plan: 2 x 32768 rows x
+# fp32 x H must fit VMEM alongside the working buffers, so fusion only
+# ever applies to small groups/widths; run_binned re-gates on the real H
+# at trace time and falls back to the flat two-pass path.
+_FUSE_MAX_STG_ROWS = 1 << 15
 
 
 from typing import NamedTuple
@@ -117,6 +137,18 @@ class Geometry(NamedTuple):
     # slot padding dominates; the split keeps the binned kernels on the
     # dense cells only.
     hub_minc: int = 0
+    # Flat compacted schedule (round 8): 1 = the plan builders pack every
+    # (group, block) stream into one flat chunk list at 8-row granularity
+    # (cells pad to _UNIT=8 rows instead of SLOT; a chunk may span two
+    # source blocks; staging writes become per-run size-classed DMAs from
+    # scalar-prefetched metadata), eliminating the per-(group, block)
+    # chunk rounding that made pad1=1.43 at Reddit shape.  Staging rides
+    # fp32 at both precisions — an 8-row slice of a bf16 (16, 128)-tiled
+    # buffer is sublane-misaligned, so the finer granularity buys its
+    # padding win with 2x staging DMA bytes (hardware-window question;
+    # docs/DESIGN.md §Flat schedule).  MUST stay the last field: native
+    # plan builders and the sweep tooling consume tuple(geom)[:5].
+    flat: int = 0
 
     @property
     def nslot(self) -> int:
@@ -125,6 +157,12 @@ class Geometry(NamedTuple):
     @property
     def slot2(self) -> int:
         return self.ch2 // self.slot
+
+    @property
+    def kd(self) -> int:
+        """Flat-schedule DMA descriptor slots per chunk: worst case one
+        copy per _UNIT-row unit."""
+        return self.ch // _UNIT
 
     @property
     def group_rows(self) -> int:
@@ -136,6 +174,8 @@ class Geometry(NamedTuple):
             f"slot must be a positive multiple of 16: {self}"
         assert self.ch >= self.slot and self.ch % self.slot == 0, self
         assert self.ch2 >= self.slot and self.ch2 % self.slot == 0, self
+        if self.flat:
+            assert self.ch % _UNIT == 0 and self.ch2 % _UNIT == 0, self
         return self
 
 
@@ -183,6 +223,21 @@ GEOM_MID_WIDE = Geometry(sb=512, ch=4096, slot=32, rb=512, ch2=8192,
 GEOM_SPARSE_WIDE = Geometry(sb=1024, ch=4096, slot=16, rb=1024, ch2=4096,
                             grt=1 << 23)
 
+# Flat-schedule presets (round 8, docs/DESIGN.md §Flat schedule).  The flat
+# packer removes per-(group, block) chunk rounding entirely, so the wide
+# group-row target buys nothing — and fp32 staging at grt=1<<23 would be a
+# multi-GB buffer — hence grt=0 (module default).  ch=ch2=4096 keeps both
+# phases inside _VMEM_BUDGET with fp32 staging at the nominal width
+# (phase 1: 4096x512 bf16 one-hot + 2 fp32 gbufs + 2 x blocks = 13 MB).
+# `slot` is unused by the flat kernels but must still divide ch/ch2
+# (Geometry invariant); kept at the dense default for the cache key.
+GEOM_FLAT = Geometry(sb=512, ch=4096, slot=128, rb=512, ch2=4096, flat=1)
+# Sparse flat variant: 1024-row windows for products-density graphs, where
+# the 8-row cell padding (not chunk rounding) is what the flat schedule
+# buys over GEOM_SPARSE's 16-row slots.
+GEOM_FLAT_SPARSE = Geometry(sb=1024, ch=2048, slot=16, rb=1024, ch2=2048,
+                            flat=1)
+
 # Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).  Fewer
 # groups = less per-(group, block) chunk-rounding padding in phase 1 at the
 # cost of a proportionally larger staging buffer; ROC_BINNED_GROUP_ROWS
@@ -205,6 +260,24 @@ class BinnedPlan:
       p2_dstl [G, C2*CH2, 1] dst row local to its bin (pad rows: RB)
       p2_obi  [G, C2]        group-local bin index per chunk (nondecreasing)
       p2_first[G, C2]        1 iff first chunk of its bin
+
+    Flat-schedule plans (geom.flat, round 8) reinterpret/extend the set:
+    p1_off is None (replaced by the run-list DMA metadata), p1_srcl pad
+    rows carry -1 (exact-zero one-hot row), a chunk may span two source
+    blocks (secondary-block rows store sb + local), and:
+      p1_blk2 [G, C1]        secondary x block (== p1_blk if none)
+      p1_dsrc [G, C1, KD]    staging-copy source:  cls<<16 | chunk unit
+                             (cls indexes _DMA_CLS; -1 = unused slot)
+      p1_ddst [G, C1, KD]    staging-copy destination unit (row/_UNIT)
+    Fused plans additionally carry a flattened interleaved step list
+    (phase 2 of group g overlapped with phase 1 of group g+1; built by
+    _attach_fused when the whole group's staging fits VMEM, else None):
+      f_meta  [S, 4]         (kind 0=p1/1=p2, group parity, first, stg
+                             chunk index within the group's staging)
+      f_rows  [S*CH, 1]      per-step srcl (kind 0) or dstl (kind 1)
+      f_blk/f_blk2/f_obi [S] x blocks + GLOBAL output bin per step (p1
+                             steps repeat the previous p2 step's bin)
+      f_dsrc/f_ddst [S, KD]  staging-copy run lists (kind 0; else -1)
     """
     p1_srcl: jnp.ndarray
     p1_off: jnp.ndarray
@@ -212,6 +285,16 @@ class BinnedPlan:
     p2_dstl: jnp.ndarray
     p2_obi: jnp.ndarray
     p2_first: jnp.ndarray
+    p1_blk2: jnp.ndarray = None
+    p1_dsrc: jnp.ndarray = None
+    p1_ddst: jnp.ndarray = None
+    f_meta: jnp.ndarray = None
+    f_rows: jnp.ndarray = None
+    f_blk: jnp.ndarray = None
+    f_blk2: jnp.ndarray = None
+    f_obi: jnp.ndarray = None
+    f_dsrc: jnp.ndarray = None
+    f_ddst: jnp.ndarray = None
     num_rows: int = dataclasses.field(metadata={"static": True}, default=0)
     table_rows: int = dataclasses.field(metadata={"static": True}, default=0)
     bins_per_group: int = dataclasses.field(
@@ -221,10 +304,17 @@ class BinnedPlan:
                                        default=None)
 
 
+# None-valued data fields are empty pytree subtrees: tree_map skips them,
+# and two-pass vs flat vs fused plans simply have different treedefs
+# (separate jit cache entries — intended).
+_PLAN_DATA_FIELDS = [
+    "p1_srcl", "p1_off", "p1_blk", "p2_dstl", "p2_obi", "p2_first",
+    "p1_blk2", "p1_dsrc", "p1_ddst",
+    "f_meta", "f_rows", "f_blk", "f_blk2", "f_obi", "f_dsrc", "f_ddst"]
+
 jax.tree_util.register_dataclass(
     BinnedPlan,
-    data_fields=["p1_srcl", "p1_off", "p1_blk",
-                 "p2_dstl", "p2_obi", "p2_first"],
+    data_fields=list(_PLAN_DATA_FIELDS),
     meta_fields=["num_rows", "table_rows", "bins_per_group", "geom"])
 
 
@@ -305,6 +395,14 @@ def _matmul_cost(num_edges: int, num_rows: int) -> float:
 
 def _vmem_bytes(geom: Geometry, H: int = _MODEL_H,
                 exact: bool = False) -> int:
+    if geom.flat:
+        # Flat staging is fp32 at BOTH precisions (8-row units tear bf16
+        # (16, 128) tiles); phase 1 streams TWO x blocks per chunk.
+        p1 = (geom.ch * geom.sb * 2 + 2 * geom.ch * H * 4
+              + 2 * geom.sb * H * 4)
+        p2 = (geom.ch2 * geom.rb * 2 + geom.ch2 * H * 4
+              + geom.rb * H * 4)
+        return max(p1, p2)
     stg = 4 if exact else 2
     p1 = (geom.ch * geom.sb * 2 + 2 * geom.ch * H * stg
           + geom.sb * H * 4)
@@ -332,7 +430,17 @@ def _binned_cost_model(padded_rows: int, geom: Geometry,
            else padded_rows / geom.ch) * _CHUNK_OVERHEAD_S
     ov2 = (steps2 if steps2 is not None
            else padded_rows / geom.ch2) * _CHUNK_OVERHEAD_S
-    dma1 = padded_rows / geom.slot * _SLOT_DMA_S
+    if geom.flat:
+        # Flat staging writes are per-run size-classed DMAs, not per-slot:
+        # a typical cell (~1 run) moves in a few descriptors.  Modeled at
+        # an average 4-unit (32-row) copy, fp32 so 2x the bytes — both
+        # constants to be re-fit from the next hardware window
+        # (ROADMAP standing item; the policy and the grid test price
+        # candidates through this same branch, so the ranking is
+        # self-consistent either way).
+        dma1 = padded_rows / (_UNIT * 4) * _SLOT_DMA_S * 2
+    else:
+        dma1 = padded_rows / geom.slot * _SLOT_DMA_S
     return max(mac1, ov1) + dma1 + max(mac2, ov2)
 
 
@@ -367,6 +475,79 @@ def _cell_counts(edge_src: np.ndarray, edge_dst: np.ndarray,
     return _cell_stats(edge_src, edge_dst, sb, rb)[2]
 
 
+def _flat_pack(stream_g: np.ndarray, stream_units: np.ndarray,
+               uc: int, G: int, segments: bool = False):
+    """Flat-schedule phase-1 packer: lay each group's (source-block-major)
+    unit streams into `uc`-unit chunks.  One stream = one (group, block)
+    pair's _UNIT-row units, in cell order.  A chunk may span at most TWO
+    streams — the kernel reads two x blocks per grid step — so when a
+    third block would enter a partly-filled chunk the chunk is cut early;
+    that cut and each group's final partial chunk are the only schedule
+    waste left (vs. per-(group, block) rounding in the slot schedule).
+
+    Returns (c1_per_g [G], segs) where segs is None unless ``segments``:
+    a (stream, chunk, pos, take) int64 array, one row per contiguous span
+    a stream contributes to a chunk, in global unit order.  SHARED by the
+    plan builder and _plan_steps so the step predictor is exact by
+    construction (pinned by test_plan_steps_match_built_plans)."""
+    c1_per_g = np.zeros(G, np.int64)
+    segs = [] if segments else None
+    n = len(stream_g)
+    i = 0
+    while i < n:
+        g = int(stream_g[i])
+        chunk = 0
+        fill = 0
+        nblk = 0
+        while i < n and int(stream_g[i]) == g:
+            u = int(stream_units[i])
+            if nblk >= 2 and 0 < fill and u > 0:
+                chunk += 1          # early cut: a third distinct block
+                fill = 0
+                nblk = 0
+            while u > 0:
+                if fill == uc:
+                    chunk += 1
+                    fill = 0
+                    nblk = 0
+                take = min(u, uc - fill)
+                if segments:
+                    segs.append((i, chunk, fill, take))
+                nblk += 1           # one span per (stream, chunk)
+                fill += take
+                u -= take
+            i += 1
+        c1_per_g[g] = chunk + (1 if fill > 0 else 0)
+    if segments:
+        segs = (np.asarray(segs, np.int64).reshape(-1, 4)
+                if segs else np.zeros((0, 4), np.int64))
+    return c1_per_g, segs
+
+
+def _flat_plan_steps(cell_blk, cell_bin, cnt, geom, num_bins, num_blocks,
+                     bpg, G):
+    """Flat-schedule arm of _plan_steps: cells pad to _UNIT rows, phase-1
+    chunks pack via _flat_pack, phase-2 bins pad to whole CH2 chunks."""
+    cell_units = -(-cnt // _UNIT)
+    padded = int(cell_units.sum() * _UNIT)
+    # phase 1: streams in (group, block) order — np.unique sorts the key
+    gb = (cell_bin // bpg) * num_blocks + cell_blk
+    gb_uniq, gb_inv = np.unique(gb, return_inverse=True)
+    gb_units = np.bincount(gb_inv, weights=cell_units).astype(np.int64)
+    c1_per_g, _ = _flat_pack(gb_uniq // num_blocks, gb_units,
+                             geom.ch // _UNIT, G)
+    C1 = _pad_to(max(int(c1_per_g.max(initial=0)), 1), 8)
+    # phase 2: bins stay CH2-aligned in staging (empty bins cost one chunk)
+    u2 = geom.ch2 // _UNIT
+    bin_units = np.bincount(cell_bin, weights=cell_units,
+                            minlength=num_bins).astype(np.int64)
+    bin_chunks = np.maximum(-(-bin_units // u2), 1)
+    c2_per_g = np.bincount(np.arange(num_bins) // bpg, weights=bin_chunks,
+                           minlength=G)
+    C2 = max(int(c2_per_g.max(initial=0)), 1)
+    return padded, G * C1, G * C2
+
+
 def _plan_steps(cell_blk: np.ndarray, cell_bin: np.ndarray,
                 cnt: np.ndarray, geom: Geometry, num_rows: int,
                 table_rows: int, num_edges: int):
@@ -382,6 +563,9 @@ def _plan_steps(cell_blk: np.ndarray, cell_bin: np.ndarray,
                   int(geom.group_rows / max(num_edges / num_bins, 1)),
                   _K2_CAP // num_blocks), 1)
     G = -(-num_bins // bpg)
+    if geom.flat:
+        return _flat_plan_steps(cell_blk, cell_bin, cnt, geom, num_bins,
+                                num_blocks, bpg, G)
     cell_slots = -(-cnt // geom.slot)
     padded = int(cell_slots.sum() * geom.slot)
     # phase 1: chunks per (group, block) stream, per-group sums, max
@@ -410,6 +594,8 @@ def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,
     order (the greedy-cut partitioner's output) is credited for the cells
     it never touches."""
     cnt = _cell_counts(edge_src, edge_dst, geom.sb, geom.rb)
+    if geom.flat:
+        return int((-(-cnt // _UNIT)).sum() * _UNIT)
     return int((-(-cnt // geom.slot)).sum() * geom.slot)
 
 
@@ -439,7 +625,8 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
         return None, 0.0
     cands = list(candidates) if candidates is not None else \
         [_default_geom(), GEOM_WIDE, GEOM_MID, GEOM_MID_WIDE,
-         GEOM_SPARSE, GEOM_SPARSE_WIDE, GEOM_XSPARSE]
+         GEOM_SPARSE, GEOM_SPARSE_WIDE, GEOM_XSPARSE,
+         GEOM_FLAT, GEOM_FLAT_SPARSE]
     best, best_t = None, float("inf")
     stats_cache = {}
     for g in cands:
@@ -461,7 +648,9 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
         # side (they pay its per-chunk rate but no slot padding); the
         # matmul window floor is a fixed cost of having a matmul side at
         # all.  Only worth modeling when a meaningful split exists.
-        minc = g.slot // 2
+        # (Flat geometries skip it: 8-row cell padding already absorbs
+        # the thin tail the hub split exists to offload.)
+        minc = 0 if g.flat else g.slot // 2
         thin = cnt < minc
         E_thin = int(cnt[thin].sum())
         if 0 < E_thin < E:
@@ -531,20 +720,40 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
         if plan is not None:
             return plan
     if len(edge_src) >= (1 << 20) and native.available():
-        (p1_srcl, p1_off, p1_blk, p2_dstl, p2_obi, p2_first,
-         bpg) = native.binned_plan(edge_src, edge_dst, num_rows, table_rows,
-                                   group_row_target, geom)
-        G, C1 = p1_blk.shape
-        C2 = p2_obi.shape[1]
-        plan = BinnedPlan(
-            p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * geom.ch, 1)),
-            p1_off=jnp.asarray(p1_off),
-            p1_blk=jnp.asarray(p1_blk),
-            p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * geom.ch2, 1)),
-            p2_obi=jnp.asarray(p2_obi),
-            p2_first=jnp.asarray(p2_first),
-            num_rows=num_rows, table_rows=table_rows, bins_per_group=bpg,
-            geom=geom)
+        if geom.flat:
+            (p1_srcl, p1_blk, p1_blk2, p1_dsrc, p1_ddst, p2_dstl, p2_obi,
+             p2_first, bpg) = native.binned_flat_plan(
+                 edge_src, edge_dst, num_rows, table_rows,
+                 group_row_target, geom)
+            G, C1 = p1_blk.shape
+            C2 = p2_obi.shape[1]
+            plan = _attach_fused(BinnedPlan(
+                p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * geom.ch, 1)),
+                p1_off=None,
+                p1_blk=jnp.asarray(p1_blk),
+                p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * geom.ch2, 1)),
+                p2_obi=jnp.asarray(p2_obi),
+                p2_first=jnp.asarray(p2_first),
+                p1_blk2=jnp.asarray(p1_blk2),
+                p1_dsrc=jnp.asarray(p1_dsrc),
+                p1_ddst=jnp.asarray(p1_ddst),
+                num_rows=num_rows, table_rows=table_rows,
+                bins_per_group=bpg, geom=geom))
+        else:
+            (p1_srcl, p1_off, p1_blk, p2_dstl, p2_obi, p2_first,
+             bpg) = native.binned_plan(edge_src, edge_dst, num_rows,
+                                       table_rows, group_row_target, geom)
+            G, C1 = p1_blk.shape
+            C2 = p2_obi.shape[1]
+            plan = BinnedPlan(
+                p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * geom.ch, 1)),
+                p1_off=jnp.asarray(p1_off),
+                p1_blk=jnp.asarray(p1_blk),
+                p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * geom.ch2, 1)),
+                p2_obi=jnp.asarray(p2_obi),
+                p2_first=jnp.asarray(p2_first),
+                num_rows=num_rows, table_rows=table_rows,
+                bins_per_group=bpg, geom=geom)
     else:
         plan = _build_binned_plan_numpy(edge_src, edge_dst, num_rows,
                                         table_rows, group_row_target, geom)
@@ -577,7 +786,10 @@ def _plan_cache_path(edge_src, edge_dst, num_rows, table_rows,
     h = hashlib.sha1()
     h.update(np.ascontiguousarray(edge_src, np.int64).tobytes())
     h.update(np.ascontiguousarray(edge_dst, np.int64).tobytes())
-    h.update(repr(("v1", num_rows, table_rows, group_row_target,
+    # v2: flat-schedule plans (Geometry.flat, p1_blk2/p1_dsrc/p1_ddst in
+    # the archive); the geometry tuple grew a field, so v1 files no longer
+    # match any key.
+    h.update(repr(("v2", num_rows, table_rows, group_row_target,
                    tuple(geom))).encode())
     return os.path.join(base, f"binned_plan_{h.hexdigest()}.npz")
 
@@ -593,17 +805,27 @@ def _plan_cache_load(path, num_rows, table_rows, geom):
             G = z["p1_blk"].shape[0]
             C1 = z["p1_blk"].shape[1]
             C2 = z["p2_obi"].shape[1]
-            return BinnedPlan(
+            plan = BinnedPlan(
                 p1_srcl=jnp.asarray(z["p1_srcl"].reshape(
                     G, C1 * geom.ch, 1)),
-                p1_off=jnp.asarray(z["p1_off"]),
+                p1_off=(jnp.asarray(z["p1_off"])
+                        if not geom.flat else None),
                 p1_blk=jnp.asarray(z["p1_blk"]),
                 p2_dstl=jnp.asarray(z["p2_dstl"].reshape(
                     G, C2 * geom.ch2, 1)),
                 p2_obi=jnp.asarray(z["p2_obi"]),
                 p2_first=jnp.asarray(z["p2_first"]),
+                p1_blk2=(jnp.asarray(z["p1_blk2"])
+                         if geom.flat else None),
+                p1_dsrc=(jnp.asarray(z["p1_dsrc"].reshape(
+                    G, C1, geom.kd)) if geom.flat else None),
+                p1_ddst=(jnp.asarray(z["p1_ddst"].reshape(
+                    G, C1, geom.kd)) if geom.flat else None),
                 num_rows=num_rows, table_rows=table_rows,
                 bins_per_group=int(meta[2]), geom=geom)
+            # fused step lists are NOT cached — rebuilt from the flat
+            # arrays (cheap next to the plan build they key on)
+            return _attach_fused(plan) if geom.flat else plan
     except Exception:
         return None
 
@@ -614,16 +836,23 @@ def _plan_cache_save(path, plan: BinnedPlan) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".{os.getpid()}.tmp.npz"   # savez keeps .npz as-is
         G = plan.p1_blk.shape[0]
-        np.savez(tmp,
-                 p1_srcl=np.asarray(plan.p1_srcl).reshape(G, -1),
-                 p1_off=np.asarray(plan.p1_off),
-                 p1_blk=np.asarray(plan.p1_blk),
-                 p2_dstl=np.asarray(plan.p2_dstl).reshape(G, -1),
-                 p2_obi=np.asarray(plan.p2_obi),
-                 p2_first=np.asarray(plan.p2_first),
-                 meta=np.asarray([plan.num_rows, plan.table_rows,
-                                  plan.bins_per_group], np.int64),
-                 geom=np.asarray(tuple(plan.geom), np.int64))
+        arrays = dict(
+            p1_srcl=np.asarray(plan.p1_srcl).reshape(G, -1),
+            p1_blk=np.asarray(plan.p1_blk),
+            p2_dstl=np.asarray(plan.p2_dstl).reshape(G, -1),
+            p2_obi=np.asarray(plan.p2_obi),
+            p2_first=np.asarray(plan.p2_first),
+            meta=np.asarray([plan.num_rows, plan.table_rows,
+                             plan.bins_per_group], np.int64),
+            geom=np.asarray(tuple(plan.geom), np.int64))
+        if plan.geom.flat:
+            arrays.update(
+                p1_blk2=np.asarray(plan.p1_blk2),
+                p1_dsrc=np.asarray(plan.p1_dsrc).reshape(G, -1),
+                p1_ddst=np.asarray(plan.p1_ddst).reshape(G, -1))
+        else:
+            arrays["p1_off"] = np.asarray(plan.p1_off)
+        np.savez(tmp, **arrays)
         os.replace(tmp, path)
     except Exception:
         pass
@@ -637,6 +866,9 @@ def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
     geom = (geom or _default_geom()).check()
     if geom.grt:
         group_row_target = geom.grt
+    if geom.flat:
+        return _build_flat_plan_numpy(edge_src, edge_dst, num_rows,
+                                      table_rows, group_row_target, geom)
     SB, CH, SLOT, RB, CH2 = geom[:5]      # noqa: N806 — shadow the module
     NSLOT, SLOT2 = geom.nslot, geom.slot2   # constants with plan geometry
     edge_src = np.asarray(edge_src, np.int64)
@@ -760,6 +992,271 @@ def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
         p2_first=jnp.asarray(p2_first),
         num_rows=num_rows, table_rows=table_rows,
         bins_per_group=bins_per_group, geom=geom)
+
+
+def _build_flat_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
+                           num_rows: int, table_rows: int,
+                           group_row_target: int,
+                           geom: Geometry) -> BinnedPlan:
+    """Flat-schedule oracle builder (geom.flat): same sort and cell
+    machinery as the slot builder, but cells pad to _UNIT(=8)-row units,
+    phase-1 chunks pack back-to-back across a group's (block) streams via
+    _flat_pack (a chunk may span two source blocks), and the slot-offset
+    table is replaced by per-chunk run lists of size-classed staging
+    copies (p1_dsrc/p1_ddst, consumed via scalar prefetch).  Phase 2 keeps
+    the existing kernel: bins stay CH2-aligned in staging, one bin per
+    chunk."""
+    U = _UNIT
+    SB, CH, RB, CH2 = geom.sb, geom.ch, geom.rb, geom.ch2  # noqa: N806
+    UC, U2, KD = CH // U, CH2 // U, geom.kd                # noqa: N806
+    edge_src = np.asarray(edge_src, np.int64)
+    edge_dst = np.asarray(edge_dst, np.int64)
+    E = edge_src.shape[0]
+    num_bins = max(-(-num_rows // RB), 1)
+    num_blocks = max(-(-table_rows // SB), 1)
+    bins_per_group = max(min(
+        num_bins,
+        int(group_row_target / max(E / num_bins, 1)),
+        _K2_CAP // num_blocks), 1)
+    G = -(-num_bins // bins_per_group)
+
+    bin_of = edge_dst // RB
+    blk_of = edge_src // SB
+    grp_of = bin_of // bins_per_group
+    order = np.lexsort((bin_of, blk_of, grp_of))
+    s_src, s_dst = edge_src[order], edge_dst[order]
+    s_bin, s_blk = bin_of[order], blk_of[order]
+
+    cell_key = ((grp_of[order] * num_blocks + s_blk) * num_bins + s_bin)
+    uniq, cell_start, cell_cnt = np.unique(
+        cell_key, return_index=True, return_counts=True)
+    ncell = len(uniq)
+    cell_units = -(-cell_cnt // U)
+    cell_g = uniq // (num_bins * num_blocks)
+    cell_lbin = (uniq % num_bins) - cell_g * bins_per_group
+
+    # --- phase-2 layout (units; bins CH2-aligned, block-major cells) ------
+    dense_bin_units = np.zeros(G * bins_per_group, np.int64)
+    bin_idx = cell_g * bins_per_group + cell_lbin
+    np.add.at(dense_bin_units, bin_idx, cell_units)
+    dense_bin_chunks = np.maximum(-(-dense_bin_units // U2), 1)
+    c2_per_g = dense_bin_chunks.reshape(G, bins_per_group).sum(1)
+    C2 = int(max(int(c2_per_g.max(initial=0)), 1))          # noqa: N806
+    bin_g = np.repeat(np.arange(G), bins_per_group)
+    bin_chunk_base = _prefix_within_runs(dense_bin_chunks, bin_g)
+    bo = np.argsort(bin_idx, kind="stable")
+    cell_off_in_bin = np.zeros(ncell, np.int64)
+    cell_off_in_bin[bo] = _prefix_within_runs(cell_units[bo], bin_idx[bo])
+    cell_stg_unit = bin_chunk_base[bin_idx] * U2 + cell_off_in_bin
+
+    # --- phase-1 flat packing (shared state machine) ----------------------
+    gb_key = uniq // num_bins                      # g * num_blocks + blk
+    gb_uniq, gb_inv = np.unique(gb_key, return_inverse=True)
+    gb_units = np.zeros(len(gb_uniq), np.int64)
+    np.add.at(gb_units, gb_inv, cell_units)
+    gb_g = gb_uniq // num_blocks
+    c1_per_g, segs = _flat_pack(gb_g, gb_units, UC, G, segments=True)
+    C1 = int(_pad_to(max(int(c1_per_g.max(initial=0)), 1), 8))  # noqa
+    seg_stream, seg_chunk, seg_pos, seg_take = segs.T
+    seg_g = gb_g[seg_stream]
+    seg_blk = gb_uniq[seg_stream] % num_blocks
+
+    # Per-chunk block pair: the pos==0 segment opens the chunk (primary);
+    # any pos>0 segment is a different stream of the same group
+    # (secondary).  blk2 == blk means single-block.
+    p1_blk = np.zeros((G, C1), np.int32)
+    opens = seg_pos == 0
+    p1_blk[seg_g[opens], seg_chunk[opens]] = seg_blk[opens].astype(np.int32)
+    p1_blk2 = p1_blk.copy()
+    tails = ~opens
+    p1_blk2[seg_g[tails], seg_chunk[tails]] = seg_blk[tails].astype(np.int32)
+
+    # --- per-unit chunk positions (global unit order == segment order) ----
+    total_units = int(cell_units.sum())
+    unit_cell = np.repeat(np.arange(ncell), cell_units)
+    cell_unit_base = np.cumsum(cell_units) - cell_units
+    unit_in_cell = np.arange(total_units) - np.repeat(cell_unit_base,
+                                                      cell_units)
+    seg_start = np.cumsum(seg_take) - seg_take
+    in_seg = np.arange(total_units) - np.repeat(seg_start, seg_take)
+    unit_chunk = np.repeat(seg_chunk, seg_take)
+    unit_pos = np.repeat(seg_pos, seg_take) + in_seg
+    unit_stg = cell_stg_unit[unit_cell] + unit_in_cell
+    unit_g = cell_g[unit_cell]
+
+    # --- per-edge positions -----------------------------------------------
+    edge_cell = np.repeat(np.arange(ncell), cell_cnt)
+    in_cell = np.arange(E) - np.repeat(cell_start, cell_cnt)
+    uid = cell_unit_base[edge_cell] + in_cell // U
+    p1_row = unit_chunk[uid] * CH + unit_pos[uid] * U + in_cell % U
+    stg_row = cell_stg_unit[edge_cell] * U + in_cell
+    g_of_edge = cell_g[edge_cell]
+
+    # Pad rows carry -1: no lane matches, so the one-hot emits an exact
+    # zero row — staging pad rows are deterministic zeros (unlike the slot
+    # schedule, whose pad slots are simply never written).
+    p1_srcl = np.full((G, C1 * CH), -1, np.int32)
+    local = s_src - s_blk * SB
+    sec = (p1_blk[g_of_edge, unit_chunk[uid]] != s_blk).astype(np.int64)
+    p1_srcl[g_of_edge, p1_row] = (local + SB * sec).astype(np.int32)
+
+    p2_dstl = np.full((G, C2 * CH2), RB, np.int32)
+    p2_dstl[g_of_edge, stg_row] = (s_dst - s_bin * RB).astype(np.int32)
+
+    # --- staging-copy run lists -------------------------------------------
+    # A run: consecutive chunk units writing consecutive staging units
+    # (cell fragments; accidental cross-cell merges are valid copies).
+    # Greedy 128/32/8-row decomposition, entries ordered by source unit
+    # within each chunk (== per-run order, the native builder's layout).
+    K = int(c1_per_g.max(initial=0)) + 1
+    ckey = unit_g * K + unit_chunk
+    if total_units:
+        brk = np.concatenate([[True],
+                              (ckey[1:] != ckey[:-1])
+                              | (unit_stg[1:] != unit_stg[:-1] + 1)])
+    else:
+        brk = np.zeros(0, bool)
+    run_start = np.flatnonzero(brk)
+    run_len = np.diff(np.concatenate([run_start, [total_units]]))
+    run_pos0 = unit_pos[run_start] if total_units else run_start
+    run_stg0 = unit_stg[run_start] if total_units else run_start
+    run_key = ckey[run_start] if total_units else run_start
+    ent_src, ent_dst, ent_cls, ent_key = [], [], [], []
+    off = np.zeros(len(run_start), np.int64)
+    for ci, csz in enumerate(_DMA_CLS):
+        k = (run_len - off) // csz
+        rep = np.repeat(np.arange(len(run_start)), k)
+        within = np.arange(len(rep)) - np.repeat(np.cumsum(k) - k, k)
+        start = off[rep] + within * csz
+        ent_src.append(run_pos0[rep] + start)
+        ent_dst.append(run_stg0[rep] + start)
+        ent_cls.append(np.full(len(rep), ci, np.int64))
+        ent_key.append(run_key[rep])
+        off += k * csz
+    ent_src = np.concatenate(ent_src)
+    ent_dst = np.concatenate(ent_dst)
+    ent_cls = np.concatenate(ent_cls)
+    ent_key = np.concatenate(ent_key)
+    eo = np.lexsort((ent_src, ent_key))
+    ent_src, ent_dst = ent_src[eo], ent_dst[eo]
+    ent_cls, ent_key = ent_cls[eo], ent_key[eo]
+    epos = _prefix_within_runs(np.ones(len(ent_key), np.int64), ent_key)
+    assert len(epos) == 0 or int(epos.max()) < KD
+    p1_dsrc = np.full((G, C1, KD), -1, np.int32)
+    p1_ddst = np.full((G, C1, KD), -1, np.int32)
+    p1_dsrc[ent_key // K, ent_key % K, epos] = \
+        (ent_cls * 65536 + ent_src).astype(np.int32)
+    p1_ddst[ent_key // K, ent_key % K, epos] = ent_dst.astype(np.int32)
+
+    # --- phase-2 chunk metadata (same as the slot schedule) ---------------
+    p2_obi = np.zeros((G, C2), np.int32)
+    p2_first = np.zeros((G, C2), np.int32)
+    dbc = dense_bin_chunks.reshape(G, bins_per_group)
+    for g in range(G):
+        reps = dbc[g]
+        obi = np.repeat(np.arange(bins_per_group), reps).astype(np.int32)
+        first = np.zeros(len(obi), np.int32)
+        first[np.cumsum(reps) - reps] = 1
+        p2_obi[g, :len(obi)] = obi
+        p2_first[g, :len(obi)] = first
+        if len(obi) < C2:
+            p2_obi[g, len(obi):] = obi[-1]
+    plan = BinnedPlan(
+        p1_srcl=jnp.asarray(p1_srcl.reshape(G, C1 * CH, 1)),
+        p1_off=None,
+        p1_blk=jnp.asarray(p1_blk),
+        p2_dstl=jnp.asarray(p2_dstl.reshape(G, C2 * CH2, 1)),
+        p2_obi=jnp.asarray(p2_obi),
+        p2_first=jnp.asarray(p2_first),
+        p1_blk2=jnp.asarray(p1_blk2),
+        p1_dsrc=jnp.asarray(p1_dsrc),
+        p1_ddst=jnp.asarray(p1_ddst),
+        num_rows=num_rows, table_rows=table_rows,
+        bins_per_group=bins_per_group, geom=geom)
+    return _attach_fused(plan)
+
+
+def _attach_fused(plan: BinnedPlan) -> BinnedPlan:
+    """Build the interleaved phase-fusion step list onto a flat plan when
+    an entire group's staging fits the VMEM gate (ch == ch2 and
+    C2 * ch2 <= _FUSE_MAX_STG_ROWS) — phase 2 of group g then consumes
+    VMEM-resident staging while phase 1 of group g+1 streams, removing the
+    HBM staging round-trip.  Otherwise returns the plan unchanged (flat
+    two-pass).  Built host-side at plan/cache/pad time: inside jit the
+    plan arrays are tracers, so the schedule cannot be derived at trace
+    time.  run_binned re-gates on the real H before using it."""
+    geom = plan.geom
+    if not (geom is not None and geom.flat and geom.ch == geom.ch2):
+        return plan
+    G, C2 = plan.p2_obi.shape
+    if C2 * geom.ch2 > _FUSE_MAX_STG_ROWS:
+        return plan
+    CH, RB, KD, bpg = geom.ch, geom.rb, geom.kd, plan.bins_per_group
+    srcl = np.asarray(plan.p1_srcl).reshape(G, -1)
+    dstl = np.asarray(plan.p2_dstl).reshape(G, -1)
+    blk = np.asarray(plan.p1_blk)
+    blk2 = np.asarray(plan.p1_blk2)
+    dsrc = np.asarray(plan.p1_dsrc)
+    ddst = np.asarray(plan.p1_ddst)
+    obi = np.asarray(plan.p2_obi)
+    first = np.asarray(plan.p2_first)
+    C1 = blk.shape[1]
+    # Real (non-pad) chunks: a real phase-1 chunk's first unit row is a
+    # live edge (srcl >= 0); a real phase-2 chunk either opens its bin
+    # (first=1 — required even for empty bins: it zeroes the window) or
+    # carries live rows.  Pad chunks are skipped outright.
+    p1_real = [[c for c in range(C1) if srcl[g, c * CH] >= 0]
+               for g in range(G)]
+    p2_real = [[q for q in range(C2)
+                if first[g, q] == 1
+                or (dstl[g, q * CH:(q + 1) * CH] < RB).any()]
+               for g in range(G)]
+    steps = [(0, 0, c) for c in p1_real[0]]
+    for g in range(G):
+        a = [(1, g, q) for q in p2_real[g]]
+        b = ([(0, g + 1, c) for c in p1_real[g + 1]]
+             if g + 1 < G else [])
+        for i in range(max(len(a), len(b))):
+            if i < len(a):
+                steps.append(a[i])
+            if i < len(b):
+                steps.append(b[i])
+    S = _pad_to(max(len(steps), 1), 8)
+    f_meta = np.zeros((S, 4), np.int32)
+    f_rows = np.full((S, CH), RB, np.int32)   # pad steps: masked p2 no-op
+    f_blk = np.zeros(S, np.int32)
+    f_blk2 = np.zeros(S, np.int32)
+    f_obi = np.zeros(S, np.int32)
+    f_dsrc = np.full((S, KD), -1, np.int32)
+    f_ddst = np.full((S, KD), -1, np.int32)
+    f_meta[:, 0] = 1                           # pad steps are kind=p2
+    cur_blk = cur_blk2 = cur_obi = 0
+    for i, (kind, g, c) in enumerate(steps):
+        if kind == 0:
+            cur_blk, cur_blk2 = int(blk[g, c]), int(blk2[g, c])
+            f_meta[i] = (0, g % 2, 0, 0)
+            f_rows[i] = srcl[g, c * CH:(c + 1) * CH]
+            f_dsrc[i] = dsrc[g, c]
+            f_ddst[i] = ddst[g, c]
+        else:
+            cur_obi = g * bpg + int(obi[g, c])
+            f_meta[i] = (1, g % 2, int(first[g, c]), c)
+            f_rows[i] = dstl[g, c * CH:(c + 1) * CH]
+        f_blk[i], f_blk2[i], f_obi[i] = cur_blk, cur_blk2, cur_obi
+    if len(steps) < S:                         # pad: revisit the last bin
+        f_meta[len(steps):, 1] = steps[-1][1] % 2 if steps else 0
+        f_blk[len(steps):] = cur_blk
+        f_blk2[len(steps):] = cur_blk2
+        f_obi[len(steps):] = cur_obi
+    return dataclasses.replace(
+        plan,
+        f_meta=jnp.asarray(f_meta),
+        f_rows=jnp.asarray(f_rows.reshape(S * CH, 1)),
+        f_blk=jnp.asarray(f_blk),
+        f_blk2=jnp.asarray(f_blk2),
+        f_obi=jnp.asarray(f_obi),
+        f_dsrc=jnp.asarray(f_dsrc),
+        f_ddst=jnp.asarray(f_ddst))
 
 
 # ---------------------------------------------------------------------------
@@ -919,6 +1416,128 @@ def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
     )(blk, off, srcl, x)
 
 
+def _flat_copy(gbuf, stg_ref, sems, p, v, du, start: bool):
+    """One size-classed staging copy from a packed descriptor: v encodes
+    cls<<16 | source unit, du is the destination unit.  Three static
+    branches — pl.ds sizes must be compile-time — of 128/32/8 rows."""
+    cls = v // 65536
+    su = v - cls * 65536
+    for ci, csz in enumerate(_DMA_CLS):
+        @pl.when(cls == ci)
+        def _(csz=csz):
+            cp = pltpu.make_async_copy(
+                gbuf.at[p].at[pl.ds(su * _UNIT, csz * _UNIT)],
+                stg_ref.at[pl.ds(du * _UNIT, csz * _UNIT)],
+                sems.at[p])
+            (cp.start if start else cp.wait)()
+
+
+def _p1_flat_kernel(blk_ref, blk2_ref, dsrc_ref, ddst_ref, srcl_ref,
+                    x_ref, x2_ref, stg_ref, gbuf, dbs, dbd, sems, *,
+                    exact: bool = False, geom: Geometry = None,
+                    pipeline: bool = True):
+    """Flat-schedule phase 1: every grid step is a full-width chunk.  The
+    one-hot expands against TWO x blocks (srcl in [0, SB) hits the
+    primary, [SB, 2SB) the secondary — a chunk spans at most two source
+    blocks by plan construction; -1 pad rows match nothing and stage
+    exact zeros), then the chunk scatters to bin-major staging via the
+    plan's size-classed copy run list (KD descriptors, SMEM).  Double
+    buffering mirrors _p1_kernel: copies issued for chunk c drain at
+    c+2, with dbs/dbd keeping each parity's descriptors for the wait;
+    pipeline=False is the ROC_BINNED_NO_PIPELINE bisection baseline."""
+    CH, SB, KD = geom.ch, geom.sb, geom.kd                         # noqa
+    c = pl.program_id(0)
+    par = c % 2 if pipeline else 0
+
+    def drain_parity(p):
+        def drain(e, _):
+            @pl.when(dbs[p, e] >= 0)
+            def _():
+                _flat_copy(gbuf, stg_ref, sems, p, dbs[p, e], dbd[p, e],
+                           start=False)
+            return 0
+        jax.lax.fori_loop(0, KD, drain, 0)
+
+    if pipeline:
+        @pl.when(c >= 2)        # chunk c-2 used this parity's buffers
+        def _():
+            drain_parity(par)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+    sl = srcl_ref[:]
+    t1 = (lane == sl).astype(jnp.bfloat16)
+    gbuf[par] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())), exact)
+
+    @pl.when(blk2_ref[c] != blk_ref[c])
+    def _():
+        # secondary-block rows (disjoint from the primary's by the
+        # +SB encoding, so the sum is exact row selection)
+        t2 = (lane == sl - SB).astype(jnp.bfloat16)
+        gbuf[par] = gbuf[par] + _onehot_dot(
+            t2, x2_ref[:], (((1,), (0,)), ((), ())), exact)
+
+    # descriptors ride in (8, KD) SMEM blocks; this chunk's row is c % 8
+    def issue(e, _):
+        v = dsrc_ref[c % 8, e]
+        dbs[par, e] = v
+        dbd[par, e] = ddst_ref[c % 8, e]
+
+        @pl.when(v >= 0)
+        def _():
+            _flat_copy(gbuf, stg_ref, sems, par, v, ddst_ref[c % 8, e],
+                       start=True)
+        return 0
+    jax.lax.fori_loop(0, KD, issue, 0)
+
+    if pipeline:
+        @pl.when(c == pl.num_programs(0) - 1)
+        def _():
+            drain_parity(par)
+
+            @pl.when(c >= 1)
+            def _():
+                drain_parity(1 - par)
+    else:
+        drain_parity(0)
+
+
+@partial(jax.jit, static_argnames=("nchunks", "stg_rows", "interpret",
+                                   "exact", "geom"))
+def _p1_flat_run(x, blk, blk2, dsrc, ddst, srcl, nchunks: int,
+                 stg_rows: int, interpret: bool = False,
+                 exact: bool = False, geom: Geometry = None):
+    pipeline = not os.environ.get("ROC_BINNED_NO_PIPELINE")
+    kernel = partial(_p1_flat_kernel, exact=exact, geom=geom,
+                     pipeline=pipeline)
+    H = x.shape[-1]
+    CH, SB, KD = geom.ch, geom.sb, geom.kd                         # noqa
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                  # blk, blk2 [C1]
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((8, KD), lambda c, blk, blk2: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, blk, blk2: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, blk, blk2: (c, 0)),
+            pl.BlockSpec((SB, H), lambda c, blk, blk2: (blk[c], 0)),
+            pl.BlockSpec((SB, H), lambda c, blk, blk2: (blk2[c], 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        # flat staging is fp32 at both precisions (8-row units tear bf16
+        # tiles); gbuf likewise
+        scratch_shapes=[pltpu.VMEM((2, CH, H), jnp.float32),
+                        pltpu.SMEM((2, KD), jnp.int32),
+                        pltpu.SMEM((2, KD), jnp.int32),
+                        pltpu.SemaphoreType.DMA((2,))],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((stg_rows, H), jnp.float32),
+        interpret=interpret,
+    )(blk, blk2, dsrc, ddst, srcl, x, x)
+
+
 # ---------------------------------------------------------------------------
 # Phase-2 kernel: sequential staging read + windowed one-hot scatter.
 # ---------------------------------------------------------------------------
@@ -964,6 +1583,152 @@ def _p2_run(stg, obi, first, dstl, nchunks: int, out_rows: int,
     )(obi, first, dstl, stg)
 
 
+# ---------------------------------------------------------------------------
+# Fused pipeline: phase-1/phase-2 steps interleaved in ONE grid, staging
+# resident in VMEM (flat plans whose whole group fits the budget).
+# ---------------------------------------------------------------------------
+
+def _fused_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref, ddst_ref,
+                  rows_ref, x_ref, x2_ref, out_ref, gbuf, stgbuf, sems, *,
+                  exact: bool = False, geom: Geometry = None):
+    """One grid step = one plan-scheduled step: kind 0 (phase 1) expands
+    a chunk and copies it into the VMEM-resident staging parity of its
+    group; kind 1 (phase 2) scatter-adds one staging chunk of that parity
+    into the resident out bin.  Group parities alternate, so phase 2 of
+    group g reads parity g%2 while phase 1 of group g+1 fills the other —
+    the interleave order (plan-built, _attach_fused) guarantees p1(g)
+    precedes p2(g) and p2(g) completes before p1(g+2) reuses its parity.
+    The out index (global bin) is nondecreasing, so out windows are never
+    revisited after writeback; every bin opens with first=1, which zeroes
+    the fetched garbage."""
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    c = pl.program_id(0)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+        sl = rows_ref[:]
+        t1 = (lane == sl).astype(jnp.bfloat16)
+        gbuf[:] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())),
+                              exact)
+
+        @pl.when(blk2_ref[c] != blk_ref[c])
+        def _():
+            t2 = (lane == sl - SB).astype(jnp.bfloat16)
+            gbuf[:] = gbuf[:] + _onehot_dot(
+                t2, x2_ref[:], (((1,), (0,)), ((), ())), exact)
+
+        # VMEM->VMEM staging copies: issue all, drain all within the step
+        # (the overlap is across phases here, not across copies)
+        def issue(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * _UNIT, csz * _UNIT)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * _UNIT, csz * _UNIT)],
+                            sems.at[0]).start()
+            return 0
+        jax.lax.fori_loop(0, KD, issue, 0)
+
+        def drain(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * _UNIT, csz * _UNIT)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * _UNIT, csz * _UNIT)],
+                            sems.at[0]).wait()
+            return 0
+        jax.lax.fori_loop(0, KD, drain, 0)
+
+    @pl.when(kind == 1)
+    def _():
+        @pl.when(first == 1)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        rows = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        s_t = (lane == dl).astype(jnp.bfloat16)
+        out_ref[:] += _onehot_dot(s_t, rows, (((0,), (0,)), ((), ())),
+                                  exact)
+
+
+@partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
+                                   "exact", "geom"))
+def _fused_run(x, blk, blk2, obi, meta, dsrc, ddst, rows, nsteps: int,
+               c2: int, out_rows: int, interpret: bool = False,
+               exact: bool = False, geom: Geometry = None):
+    H = x.shape[-1]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    srows = c2 * geom.ch2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,                  # blk, blk2, obi [S]
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((8, 4), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, b, b2, o: (c, 0)),
+            pl.BlockSpec((SB, H), lambda c, b, b2, o: (b[c], 0)),
+            pl.BlockSpec((SB, H), lambda c, b, b2, o: (b2[c], 0)),
+        ],
+        out_specs=pl.BlockSpec((RB, H), lambda c, b, b2, o: (o[c], 0)),
+        scratch_shapes=[pltpu.VMEM((CH, H), jnp.float32),
+                        pltpu.VMEM((2, srows, H), jnp.float32),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    return pl.pallas_call(
+        partial(_fused_kernel, exact=exact, geom=geom),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_rows, H), jnp.float32),
+        interpret=interpret,
+    )(blk, blk2, obi, meta, dsrc, ddst, rows, x, x)
+
+
+def _fused_vmem_ok(geom: Geometry, Hp: int, c2: int) -> bool:
+    """Trace-time gate for actually RUNNING a stored fused schedule at
+    this width: both staging parities + gbuf + the one-hot intermediates
+    + two x blocks + the out window must fit the VMEM budget."""
+    srows = c2 * geom.ch2
+    need = (2 * srows * Hp * 4 + geom.ch * Hp * 4
+            + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
+            + 2 * geom.sb * Hp * 4 + geom.rb * Hp * 4)
+    return need <= _VMEM_BUDGET
+
+
+# one-shot: the eager path is a silent ~9x dispatch-overhead footgun
+# (1.65 s vs 184 ms jitted at Reddit scale, docs/PERF.md) — warn once
+# per process, never per call.
+_EAGER_WARNED = [False]
+
+
 def run_binned(x, plan: BinnedPlan, interpret: bool = False,
                precision: str = "fast"):
     """out[v] = sum over in-edges of x[src] via the two-phase schedule.
@@ -977,6 +1742,13 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False,
     Call under jit (the trainer always does): measured on v5e at Reddit
     scale, the eager path pays ~6x in scan dispatch overhead (1.65 s vs
     213 ms jitted — docs/PERF.md)."""
+    if not _EAGER_WARNED[0] and jax.core.trace_state_clean():
+        _EAGER_WARNED[0] = True
+        warnings.warn(
+            "run_binned called outside a jit trace: the eager scan path "
+            "pays ~9x in dispatch overhead (1.65 s vs 184 ms jitted at "
+            "Reddit scale, docs/PERF.md) — wrap the caller in jax.jit.",
+            stacklevel=2)
     if precision not in ("fast", "exact"):
         # same rule as ops.aggregate.matmul_precision: a silent fallthrough
         # to the fast path would drop the fp32-exact guarantee
@@ -1001,6 +1773,38 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False,
     xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, geom.sb) - x.shape[0]),
                      (0, Hp - H)))
     stg_rows = C2 * geom.ch2
+
+    if geom.flat:
+        out_rows = G * plan.bins_per_group * geom.rb
+        if (plan.f_meta is not None
+                and not os.environ.get("ROC_BINNED_NO_FUSE")
+                and _fused_vmem_ok(geom, Hp, C2)):
+            # fused pipeline: one grid, staging VMEM-resident, phases of
+            # adjacent groups interleaved (gating re-checked against the
+            # REAL padded width — the plan-build gate used a model H)
+            S = int(plan.f_blk.shape[0])
+            out = _fused_run(xp, plan.f_blk, plan.f_blk2, plan.f_obi,
+                             plan.f_meta, plan.f_dsrc, plan.f_ddst,
+                             plan.f_rows, S, C2, out_rows, interpret,
+                             exact, geom)
+            return out[:plan.num_rows, :H].astype(x.dtype)
+
+        def fbody(_, gplan):
+            srcl, blk, blk2, dsrc, ddst, dstl, obi, first = gplan
+            stg = _p1_flat_run(xp, blk, blk2, dsrc, ddst, srcl, C1,
+                               stg_rows, interpret, exact, geom)
+            out_g = _p2_run(stg, obi, first, dstl, C2,
+                            plan.bins_per_group * geom.rb, interpret,
+                            exact, geom)
+            return None, out_g
+
+        _, outs = jax.lax.scan(
+            fbody, None,
+            (plan.p1_srcl, plan.p1_blk, plan.p1_blk2,
+             plan.p1_dsrc, plan.p1_ddst,
+             plan.p2_dstl, plan.p2_obi, plan.p2_first))
+        out = outs.reshape(out_rows, Hp)
+        return out[:plan.num_rows, :H].astype(x.dtype)
 
     def body(_, gplan):
         srcl, off, blk, dstl, obi, first = gplan
@@ -1033,6 +1837,28 @@ def pad_binned_plan(plan: BinnedPlan, C1: int, C2: int) -> BinnedPlan:
     d1, d2 = C1 - c1, C2 - c2
     if d1 == 0 and d2 == 0:
         return plan
+    if geom.flat:
+        # flat pads: every slot masked (-1 -> one-hot no-match -> zero
+        # row), no staging copies (dsrc/ddst -1), phase 2 revisits the
+        # last bin fully masked.  Fused arrays stay valid — they index
+        # only real chunks, and staging chunk ids are a prefix of the
+        # padded layout — so keep them.
+        return dataclasses.replace(
+            plan,
+            p1_srcl=jnp.pad(plan.p1_srcl,
+                            ((0, 0), (0, d1 * geom.ch), (0, 0)),
+                            constant_values=-1),
+            p1_blk=jnp.pad(plan.p1_blk, ((0, 0), (0, d1))),
+            p1_blk2=jnp.pad(plan.p1_blk2, ((0, 0), (0, d1))),
+            p1_dsrc=jnp.pad(plan.p1_dsrc, ((0, 0), (0, d1), (0, 0)),
+                            constant_values=-1),
+            p1_ddst=jnp.pad(plan.p1_ddst, ((0, 0), (0, d1), (0, 0)),
+                            constant_values=-1),
+            p2_dstl=jnp.pad(plan.p2_dstl,
+                            ((0, 0), (0, d2 * geom.ch2), (0, 0)),
+                            constant_values=geom.rb),
+            p2_obi=jnp.pad(plan.p2_obi, ((0, 0), (0, d2)), mode="edge"),
+            p2_first=jnp.pad(plan.p2_first, ((0, 0), (0, d2))))
     return BinnedPlan(
         p1_srcl=jnp.pad(plan.p1_srcl, ((0, 0), (0, d1 * geom.ch), (0, 0))),
         p1_off=jnp.pad(plan.p1_off, ((0, 0), (0, d1), (0, 0)),

@@ -532,7 +532,17 @@ def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
     multihost, ``allgather`` raises the pad floors to the global chunk-count
     maxima so every process compiles the same program."""
     if backend == "binned":
-        plan_list = [ops.build_binned_plans(srcs[i], dsts[i], S, table_rows)
+        # ROC_BINNED_FLAT=1 forces the flat compacted chunk schedule for
+        # every shard plan (hardware A/B lever for sweep_binned /
+        # hw_revalidate; default remains choose_geometry's pick).  The
+        # fused single-grid path is stripped at stacking time
+        # (pad_binned_plans) — sharded plans take the flat two-pass scan.
+        geom = None
+        if os.environ.get("ROC_BINNED_FLAT") == "1":
+            from roc_tpu.ops.pallas.binned import GEOM_FLAT
+            geom = GEOM_FLAT
+        plan_list = [ops.build_binned_plans(srcs[i], dsts[i], S, table_rows,
+                                            geom=geom)
                      for i in range(len(srcs))]
         f = _allgather_floors(
             [[p.fwd.p1_blk.shape[1] for p in plan_list],

@@ -182,6 +182,72 @@ def test_lint_zero_false_positives_on_tree():
     assert lint.lint_paths(paths) == []
 
 
+def test_lint_closure_capture_ignores_decorator_names():
+    """A loop variable used ONLY in a decorator expression is bound at def
+    time (decorators evaluate eagerly) — the pl.when(c == i) closure idiom
+    in the Pallas kernels must not be flagged as late capture."""
+    src = ("import pallas as pl\nfns = []\nfor ci in range(3):\n"
+           "    @pl.when(c == ci)\n"
+           "    def _(csz=8):\n        return csz\n"
+           "    fns.append(_)\n")
+    assert [f for f in lint.lint_source(src)
+            if f.rule == "closure-capture"] == [], lint.lint_source(src)
+    # ...but using it in the BODY still flags
+    src2 = src.replace("return csz", "return ci")
+    assert any(f.rule == "closure-capture" for f in lint.lint_source(src2))
+
+
+# -- mosaic-align lint ----------------------------------------------------
+
+_MOSAIC_FIXTURE = """\
+import jax.experimental.pallas as pl
+from jax.experimental import pallas
+
+UNIT = 8
+H = 41
+
+def kernel(x_ref, o_ref):
+    a = x_ref[pl.ds(0, 41)]              # sublane 41 % 8 != 0: flag
+    b = x_ref[pl.ds(0, 3 * UNIT)]        # 24 % 8 == 0: clean
+    c = x_ref[pl.ds(s, csz * UNIT)]      # runtime * aligned factor: clean
+    return a, b, c
+
+spec_bad = pl.BlockSpec((8, H), lambda i: (i, 0))        # lane 41: flag
+spec_bad2 = pl.BlockSpec((12, 128), lambda i: (i, 0))    # sublane 12: flag
+spec_ok = pl.BlockSpec((8, 128), lambda i: (i, 0))
+spec_col = pl.BlockSpec((512, 1), lambda i: (i, 0))      # (N, 1): exempt
+spec_smem = pl.BlockSpec((8, 4), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM)        # SMEM: exempt
+spec_dyn = pl.BlockSpec((n, h), lambda i: (i, 0))        # unresolvable
+"""
+
+
+def test_mosaic_lint_flags_fixture():
+    from roc_tpu.analysis import mosaic
+    fs = mosaic.lint_source(_MOSAIC_FIXTURE, "<fixture>")
+    assert len(fs) == 3, fs
+    assert all(f.rule == "mosaic-align" for f in fs)
+    lines = sorted(f.line for f in fs)
+    assert lines == [8, 13, 14], fs   # the ds(0,41) + two bad BlockSpecs
+
+
+def test_mosaic_lint_waiver():
+    from roc_tpu.analysis import mosaic
+    src = _MOSAIC_FIXTURE.replace(
+        "# sublane 41 % 8 != 0: flag", "# roclint: allow(mosaic-align)")
+    fs = mosaic.lint_source(src, "<fixture>")
+    assert len(fs) == 2 and all(f.line > 8 for f in fs), fs
+
+
+def test_mosaic_lint_clean_on_tree():
+    """Zero findings on the shipped kernels — the conservative-resolution
+    contract (unresolvable dims are skipped, not flagged)."""
+    from roc_tpu.analysis import mosaic
+    paths = [os.path.join(ROOT, "roc_tpu"), os.path.join(ROOT, "tools"),
+             os.path.join(ROOT, "bench.py")]
+    assert mosaic.lint_paths(paths) == []
+
+
 def test_analyze_flag_parses():
     from roc_tpu.train.config import parse_args
     cfg = parse_args(["-dataset", "x", "-layers", "8-4", "-analyze"])

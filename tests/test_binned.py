@@ -506,13 +506,15 @@ def test_choose_geometry_policy():
     # model prices the matmul backend's per-VB-window >=1-chunk floor
     # (segment_sum.build_chunk_plan — ceil(100k/8) = 12.5k chunks here
     # REGARDLESS of edge count, the products-shape matmul pathology), so
-    # even uniform sparse now beats it on a sparse-window preset.  The
-    # round-2 model, floorless, pinned matmul here.
+    # even uniform sparse now beats it — either on a sparse-window preset
+    # (small slots) or, since round 8, on a FLAT preset whose 8-row cell
+    # granularity removes slot padding outright.  The round-2 model,
+    # floorless, pinned matmul here.
     n, e = 100_000, 500_000
     src = rng.integers(0, n, e).astype(np.int64)
     dst = rng.integers(0, n, e).astype(np.int64)
     g_u, t_u = B.choose_geometry(src, dst, n, n)
-    assert g_u is not None and g_u.slot <= 32, (g_u, t_u)
+    assert g_u is not None and (g_u.flat or g_u.slot <= 32), (g_u, t_u)
     assert t_u < B._matmul_cost(e, n), (t_u, B._matmul_cost(e, n))
 
     # same density, block-diagonal communities: cells concentrate on the
@@ -559,9 +561,13 @@ def test_sweep_products_configs_match_presets():
                                       "tools", "sweep_binned.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    want = [tuple(g)[:5] + (g.grt or B._GROUP_ROW_TARGET,)
+    want = [tuple(g)[:5] + (g.grt or B._GROUP_ROW_TARGET, 0)
             for g in (B.GEOM_MID, B.GEOM_MID_WIDE, B.GEOM_SPARSE,
                       B.GEOM_SPARSE_WIDE, B.GEOM_XSPARSE)]
+    # flat A/B leg: GEOM_FLAT_SPARSE at the production group target,
+    # paired against the same-shape GEOM_SPARSE row above
+    want.append(tuple(B.GEOM_FLAT_SPARSE)[:5]
+                + (B.GEOM_FLAT_SPARSE.grt or B._GROUP_ROW_TARGET, 1))
     assert mod.CONFIGS_PRODUCTS == want, (mod.CONFIGS_PRODUCTS, want)
 
 
@@ -602,7 +608,8 @@ def test_plan_steps_match_built_plans():
     from roc_tpu.ops.pallas import binned as B
     rng = np.random.default_rng(7)
     shapes = [(3000, 40_000, 0), (20_000, 80_000, 0), (20_000, 80_000, 512)]
-    for g in (B._default_geom(), B.GEOM_MID, B.GEOM_SPARSE_WIDE):
+    for g in (B._default_geom(), B.GEOM_MID, B.GEOM_SPARSE_WIDE,
+              B.GEOM_FLAT, B.GEOM_FLAT_SPARSE):
         for n, e, q in shapes:
             if q:                     # block-diagonal community locality
                 comm = rng.integers(0, n // q, e) * q
@@ -632,7 +639,8 @@ def test_cost_model_grid_validation():
     from roc_tpu.ops.pallas import binned as B
     rng = np.random.default_rng(11)
     cands = [B._default_geom(), B.GEOM_WIDE, B.GEOM_MID, B.GEOM_MID_WIDE,
-             B.GEOM_SPARSE, B.GEOM_SPARSE_WIDE, B.GEOM_XSPARSE]
+             B.GEOM_SPARSE, B.GEOM_SPARSE_WIDE, B.GEOM_XSPARSE,
+             B.GEOM_FLAT, B.GEOM_FLAT_SPARSE]
     cells = []
     for n in (8192, 24576):
         for deg in (4, 16, 48):

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Kernel step-budget gate (tools/kernel_budgets.json).
+
+The binned schedules' predicted grid-step counts are pure host arithmetic
+(binned._plan_steps over _cell_stats), so a schedule regression — pad
+creep, chunk-count blowup, a packer change that silently doubles phase-1
+steps — is checkable offline, exactly like the collective-budget audit.
+This tool recomputes the canonical table (Reddit-scale + products-scale
+synthetic shapes, shipped geometries) and diffs it EXACTLY against the
+committed JSON; any drift fails preflight until the table is regenerated
+with --update and the diff is reviewed.
+
+It also pins the flat-schedule acceptance claim: at the Reddit shape the
+flat schedule must keep total predicted steps <= 0.75x the shipped
+SLOT=128 geometry (the >= 25% reduction of record, docs/PERF.md).
+
+    python tools/check_kernel_budgets.py            # diff, exit 1 on drift
+    python tools/check_kernel_budgets.py --update   # regenerate the table
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "kernel_budgets.json")
+
+# (name, num_rows/table_rows, num_edges, rng seed).  Uniform synthetic
+# stand-ins sized to run the O(E) statistics in seconds; the REAL graphs'
+# numbers live in docs/PERF.md and are hardware-window material.
+SHAPES = [
+    ("reddit_scaled", 32768, 4_194_304, 0),
+    ("products_scaled", 262_144, 2_097_152, 1),
+]
+
+# Max allowed flat/default total-step ratio at the Reddit-scale shape
+# (the tentpole acceptance criterion: >= 25% reduction).
+FLAT_MAX_RATIO = 0.75
+
+
+def _geometries():
+    import roc_tpu.ops.pallas.binned as B
+    return [
+        ("default", B._default_geom()),
+        ("wide", B.GEOM_WIDE),
+        ("sparse_wide", B.GEOM_SPARSE_WIDE),
+        ("flat", B.GEOM_FLAT),
+        ("flat_sparse", B.GEOM_FLAT_SPARSE),
+    ]
+
+
+def compute_table():
+    import numpy as np
+    import roc_tpu.ops.pallas.binned as B
+    table = {}
+    for name, n, e, seed in SHAPES:
+        rng = np.random.default_rng(seed)
+        src = rng.integers(0, n, size=e).astype(np.int64)
+        dst = rng.integers(0, n, size=e).astype(np.int64)
+        entry = {"num_rows": n, "num_edges": e, "seed": seed,
+                 "geometries": {}}
+        for gname, geom in _geometries():
+            cb, cn, cnt = B._cell_stats(src, dst, geom.sb, geom.rb)
+            padded, s1, s2 = B._plan_steps(cb, cn, cnt, geom, n, n, e)
+            entry["geometries"][gname] = {
+                "padded_rows": int(padded),
+                "steps_phase1": int(s1),
+                "steps_phase2": int(s2),
+                "steps_total": int(s1 + s2),
+            }
+        table[name] = entry
+    return table
+
+
+def check_flat_claim(table):
+    g = table["reddit_scaled"]["geometries"]
+    flat, dflt = g["flat"]["steps_total"], g["default"]["steps_total"]
+    if flat > FLAT_MAX_RATIO * dflt:
+        return [f"flat schedule regression: {flat} steps vs default "
+                f"{dflt} at reddit_scaled — ratio "
+                f"{flat / dflt:.3f} > {FLAT_MAX_RATIO}"]
+    return []
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    update = "--update" in argv
+    table = compute_table()
+    problems = check_flat_claim(table)
+    if update:
+        if problems:
+            for p in problems:
+                print(f"KERNEL BUDGET VIOLATION: {p}")
+            return 1
+        with open(BUDGETS_PATH, "w", encoding="utf-8") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"# kernel_budgets: wrote {BUDGETS_PATH}")
+        return 0
+    if not os.path.exists(BUDGETS_PATH):
+        print(f"KERNEL BUDGET VIOLATION: {BUDGETS_PATH} missing — run "
+              f"with --update and commit it")
+        return 1
+    with open(BUDGETS_PATH, encoding="utf-8") as f:
+        committed = json.load(f)
+    if committed != table:
+        for name in sorted(set(committed) | set(table)):
+            a, b = committed.get(name), table.get(name)
+            if a != b:
+                problems.append(f"{name}: committed {a} != computed {b}")
+    for p in problems:
+        print(f"KERNEL BUDGET VIOLATION: {p}")
+    n = len(problems)
+    print(f"# kernel_budgets: {n} violation(s)", file=sys.stderr)
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,9 +113,17 @@ timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
 fi
 
 if [ "$START" -le 4 ]; then
-note "4. TPU-gated kernel tests (incl. H=41, fallback kernel, avg)"
+note "4. TPU-gated kernel tests (incl. H=41, fallback kernel, avg, flat)"
 PYTHONPATH=/root/.axon_site:$PWD timeout 1200 python tests/test_tpu_hw.py \
     2>&1 | tail -3 | tee -a "$LOG"
+
+note "4b. flat-vs-slot-padded A/B at Reddit scale (same shape, flat=0/1;"
+note "    model predicts ~37% fewer grid steps — record the measured ratio"
+note "    in docs/PERF.md and re-fit the flat DMA constant from it)"
+for flat in 0 1; do
+    timeout 900 python tools/sweep_binned.py 512 4096 128 512 4096 \
+        2097152 $flat 2>&1 | tail -1 | tee -a "$LOG"
+done
 fi
 
 if [ "$START" -le 5 ]; then

@@ -27,6 +27,14 @@ python tools/roclint.py || {
 echo "== budget audit =="
 timeout -k 10 600 python tools/roclint.py --audit --no-lint || {
     echo "preflight: collective budget audit RED" >&2; exit 1; }
+# Kernel step budgets: predicted binned grid-step counts at the canonical
+# shapes must match tools/kernel_budgets.json exactly, and the flat
+# schedule must hold its >=25% step reduction over the shipped default.
+# Regenerate deliberate drifts with tools/check_kernel_budgets.py --update.
+echo "== kernel step budgets =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python tools/check_kernel_budgets.py || {
+    echo "preflight: kernel step budgets RED" >&2; exit 1; }
 
 # Memory-plan determinism gate: the same config must produce a
 # byte-identical plan JSON (the plan participates in the step cache key —

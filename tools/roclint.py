@@ -59,8 +59,10 @@ def main(argv=None) -> int:
     do_lint = not args.no_lint and (
         bool(args.paths) or not (args.audit or args.update_budgets))
     if do_lint:
-        from roc_tpu.analysis import lint
-        findings = lint.lint_paths(args.paths or DEFAULT_PATHS)
+        from roc_tpu.analysis import lint, mosaic
+        paths = args.paths or DEFAULT_PATHS
+        findings = sorted(lint.lint_paths(paths) + mosaic.lint_paths(paths),
+                          key=lambda f: (f.path, f.line))
         for f in findings:
             print(f)
         n = len(findings)

@@ -12,11 +12,15 @@ shape instead (the north-star A/B's kernel-level companion).
 
 Results of record: docs/PERF.md (2026-07-31 sweep that picked SLOT=128).
 Run on hardware:  python tools/sweep_binned.py
-One config (child mode): python tools/sweep_binned.py SB CH SLOT RB CH2 GRT
+One config (child mode): python tools/sweep_binned.py SB CH SLOT RB CH2 GRT [FLAT]
 
-Edit CONFIGS below; each row is (SB, CH, SLOT, RB, CH2, group_row_target).
-After changing shipped defaults, mirror them in roc_tpu/ops/pallas/binned.py
-AND the BN_* constants in roc_tpu/native/src/roc_native.cc.
+Edit CONFIGS below; each row is (SB, CH, SLOT, RB, CH2, group_row_target,
+flat).  flat=1 builds the flat compacted schedule (binned.py GEOM_FLAT
+family) instead of the slot-padded one — paired flat=0/flat=1 rows at the
+same shape are the A/B that validates the predicted step reduction on
+hardware.  After changing shipped defaults, mirror them in
+roc_tpu/ops/pallas/binned.py AND the BN_* constants in
+roc_tpu/native/src/roc_native.cc.
 """
 import os
 import subprocess
@@ -37,14 +41,16 @@ CHILD_TIMEOUT_S = int(os.environ.get("SWEEP_TIMEOUT_S", 600))
 # pins these against the Geometry literals, so a preset retune that
 # forgets this mirror fails CI instead of measuring stale tuples.
 CONFIGS_PRODUCTS = [
-    (512, 2048, 32, 512, 4096, 1 << 21),     # GEOM_MID
-    (512, 4096, 32, 512, 8192, 1 << 23),     # GEOM_MID_WIDE
-    (1024, 2048, 16, 1024, 2048, 1 << 21),   # GEOM_SPARSE
-    (1024, 4096, 16, 1024, 4096, 1 << 23),   # GEOM_SPARSE_WIDE
-    (2048, 1024, 16, 2048, 1024, 1 << 21),   # GEOM_XSPARSE
+    (512, 2048, 32, 512, 4096, 1 << 21, 0),     # GEOM_MID
+    (512, 4096, 32, 512, 8192, 1 << 23, 0),     # GEOM_MID_WIDE
+    (1024, 2048, 16, 1024, 2048, 1 << 21, 0),   # GEOM_SPARSE
+    (1024, 4096, 16, 1024, 4096, 1 << 23, 0),   # GEOM_SPARSE_WIDE
+    (2048, 1024, 16, 2048, 1024, 1 << 21, 0),   # GEOM_XSPARSE
+    (1024, 2048, 16, 1024, 2048, 1 << 21, 1),   # GEOM_FLAT_SPARSE (A/B vs
+    #                                             GEOM_SPARSE: same shape)
 ]
 
-# (SB, CH, SLOT, RB, CH2, group_row_target)
+# (SB, CH, SLOT, RB, CH2, group_row_target, flat)
 # Round-5 CPU plan-statistics study (BASELINE.md round-5 notes): at Reddit
 # shape, CH=4096 + grt=2^23 cuts phase-1 grid steps 50% (16512 -> 8208)
 # and CH2=8192 cuts phase-2 steps 49% (7692 -> 3891); both phases were
@@ -53,17 +59,19 @@ CONFIGS_PRODUCTS = [
 # model (slot-padding x2.6 / MAC-bound) and are kept as controls.  CH2=8192
 # failed round 2 as an opaque tunnel 500 — capture the real Mosaic error.
 CONFIGS = [
-    (512, 2048, 128, 512, 4096, 1 << 21),   # shipped defaults (baseline)
-    (512, 2048, 128, 512, 4096, 1 << 23),   # fewer groups only
-    (512, 4096, 128, 512, 4096, 1 << 23),   # -50% phase-1 chunks
-    (512, 4096, 128, 512, 8192, 1 << 23),   # + -49% phase-2 chunks
-    (512, 4096, 128, 512, 8192, 1 << 21),   # big chunks, small staging
-    (512, 2048, 128, 256, 4096, 1 << 22),   # control: model says lose
-    (1024, 4096, 128, 512, 8192, 1 << 23),  # control: model says MAC-bound
+    (512, 2048, 128, 512, 4096, 1 << 21, 0),   # shipped defaults (baseline)
+    (512, 2048, 128, 512, 4096, 1 << 23, 0),   # fewer groups only
+    (512, 4096, 128, 512, 4096, 1 << 23, 0),   # -50% phase-1 chunks
+    (512, 4096, 128, 512, 8192, 1 << 23, 0),   # + -49% phase-2 chunks
+    (512, 4096, 128, 512, 8192, 1 << 21, 0),   # big chunks, small staging
+    (512, 2048, 128, 256, 4096, 1 << 22, 0),   # control: model says lose
+    (1024, 4096, 128, 512, 8192, 1 << 23, 0),  # control: model says MAC-bound
+    (512, 4096, 128, 512, 4096, 1 << 21, 1),   # GEOM_FLAT: flat A/B vs the
+    #                                            same-shape slot-padded row
 ]
 
 
-def run_one(sb, ch, slot, rb, ch2, grt):
+def run_one(sb, ch, slot, rb, ch2, grt, flat=0):
     """Child-process body: measure one config, print one line."""
     import numpy as np
 
@@ -80,7 +88,13 @@ def run_one(sb, ch, slot, rb, ch2, grt):
     x = jnp.asarray(rng.standard_normal((N, H), dtype=np.float32))
 
     t0 = time.time()
-    plan = B.build_binned_plan(src, dst, N, N, group_row_target=grt)
+    if flat:
+        geom = B.Geometry(sb=sb, ch=ch, slot=slot, rb=rb, ch2=ch2,
+                          grt=grt, flat=1)
+        plan = B.build_binned_plan(src, dst, N, N, geom=geom,
+                                   group_row_target=grt)
+    else:
+        plan = B.build_binned_plan(src, dst, N, N, group_row_target=grt)
     tb = time.time() - t0
     G, C1 = plan.p1_blk.shape
     C2 = plan.p2_obi.shape[1]
@@ -94,19 +108,20 @@ def run_one(sb, ch, slot, rb, ch2, grt):
         out = run(x, plan)
     _ = np.asarray(out)
     dt = (time.perf_counter() - t) / 5
-    print(f"SB={sb} CH={ch} SLOT={slot} RB={rb} CH2={ch2} grt={grt}: "
-          f"{dt*1e3:.1f} ms  (G={G} C1={C1} C2={C2} pad1={pad1:.2f} "
-          f"pad2={pad2:.2f} build={tb:.0f}s checksum={v:.6g})", flush=True)
+    print(f"SB={sb} CH={ch} SLOT={slot} RB={rb} CH2={ch2} grt={grt} "
+          f"flat={flat}: {dt*1e3:.1f} ms  (G={G} C1={C1} C2={C2} "
+          f"pad1={pad1:.2f} pad2={pad2:.2f} build={tb:.0f}s "
+          f"checksum={v:.6g})", flush=True)
 
 
 def main():
-    if len(sys.argv) == 7:                  # child mode
+    if len(sys.argv) in (7, 8):             # child mode (6 args = flat 0)
         run_one(*(int(a) for a in sys.argv[1:]))
         return
     configs = CONFIGS_PRODUCTS \
         if os.environ.get("SWEEP_SHAPE") == "products" else CONFIGS
     for cfg in configs:
-        sb, ch, slot, rb, ch2, grt = cfg
+        sb, ch, slot, rb, ch2, grt, flat = cfg
         if ch2 % slot or ch % slot:
             print(f"{cfg}: skipped (SLOT must divide CH and CH2)")
             continue
