@@ -152,6 +152,12 @@ ANALYZE = _env("ROC_BENCH_ANALYZE", "0", int)
 # and the canonical vs_baseline / last-known-good claims stay plan-off.
 MEM = _env("ROC_BENCH_MEM", "0", int)
 MEM_PLAN = os.environ.get("ROC_MEM_PLAN", "keep")
+# ROC_BF16_STORAGE=1 (the same env Config.__post_init__ honors): features
+# stored/staged/exchanged as bf16, fp32 accumulation.  Every artifact is
+# stamped with the storage dtype; bf16 legs annotate the metric and are
+# excluded from vs_baseline and the canonical last-known-good persist —
+# the reference figures are fp32-storage numbers.
+DTYPE = "bf16" if os.environ.get("ROC_BF16_STORAGE") == "1" else "fp32"
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -168,7 +174,8 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + ("" if REORDER == "off" else f"_reorder-{REORDER}")
           + ("" if INTER == "uniform" else f"_inter-{INTER}")
           + ("" if BALANCE_EVERY == 0 else f"_balance{BALANCE_EVERY}")
-          + ("" if MEM_PLAN == "keep" else f"_mem-{MEM_PLAN}"))
+          + ("" if MEM_PLAN == "keep" else f"_mem-{MEM_PLAN}")
+          + ("" if DTYPE == "fp32" else f"_{DTYPE}"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -510,8 +517,10 @@ def run():
         # mislead even though the metric name is annotated)
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
         if MODEL == "gcn" and CANONICAL_SHAPE and REORDER == "off"
-        and BALANCE_EVERY == 0 and MEM_PLAN == "keep" else None,
+        and BALANCE_EVERY == 0 and MEM_PLAN == "keep"
+        and DTYPE == "fp32" else None,
         "backend": resolved,                   # what auto resolved to
+        "dtype": DTYPE,                        # feature-storage dtype
         "platform": jax.default_backend(),
         "edges_per_sec_per_chip": round(edges_per_sec_per_chip),
         "model_tflops_per_epoch": round(flops / 1e12, 4),
@@ -585,6 +594,7 @@ def run():
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0
             and MEM_PLAN == "keep" and "binned_flat" not in result
+            and DTYPE == "fp32"
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

@@ -30,28 +30,39 @@ from roc_tpu.balance.telemetry import NUM_FEATURES
 # Conservative per-direction ICI bandwidth used only for the prior's halo
 # term (v4-lite ~ 4.5e10 B/s per link; actual halo cost is learned).
 _PRIOR_ICI_BYTES_PER_S = 4e10
-# Feature width assumed by the prior's halo-bytes estimate (the probe's H).
+# Fallback feature width / wire itemsize for the prior's halo-bytes term
+# when the caller doesn't thread the run's actual values (the probe's H and
+# an fp32 exchange).  Trainers pass the dataset width and the wire itemsize
+# (2 under bf16 storage) so the warm start prices the bytes actually moved.
 _PRIOR_HALO_WIDTH = 32
+_PRIOR_HALO_ITEMSIZE = 4
 # Relative weight of a synthesized prior sample vs a measured probe.
 PRIOR_WEIGHT = 0.1
 
 
-def prior_times(X: np.ndarray) -> np.ndarray:
+def prior_times(X: np.ndarray, halo_width: int = _PRIOR_HALO_WIDTH,
+                halo_itemsize: int = _PRIOR_HALO_ITEMSIZE) -> np.ndarray:
     """Warm-start prediction for feature rows [n, 5] (nodes, edges, halo_in,
     halo_out, 1) from the plan backends' calibrated chunk cost."""
     from roc_tpu.ops.pallas.binned import _matmul_cost
     X = np.atleast_2d(np.asarray(X, dtype=np.float64))
     t = np.array([_matmul_cost(int(e), int(n)) for n, e in X[:, :2]],
                  dtype=np.float64)
-    halo_bytes = (X[:, 2] + X[:, 3]) * _PRIOR_HALO_WIDTH * 4.0
+    halo_bytes = (X[:, 2] + X[:, 3]) * float(halo_width) * float(halo_itemsize)
     return t + halo_bytes / _PRIOR_ICI_BYTES_PER_S
 
 
 class OnlineCostModel:
     """Weighted ridge least-squares over telemetry, refit each round."""
 
-    def __init__(self, ridge: float = 1e-8):
+    def __init__(self, ridge: float = 1e-8,
+                 halo_width: int = _PRIOR_HALO_WIDTH,
+                 halo_itemsize: int = _PRIOR_HALO_ITEMSIZE):
         self.ridge = float(ridge)
+        # The run's actual exchanged-feature width and wire itemsize (bf16
+        # storage halves the latter); only the warm-start prior uses them.
+        self.halo_width = int(halo_width)
+        self.halo_itemsize = int(halo_itemsize)
         self.w: Optional[np.ndarray] = None  # [5], unscaled feature space
         self.r2: Optional[float] = None      # of the last fit's probe rows
         self.num_fits = 0
@@ -73,7 +84,8 @@ class OnlineCostModel:
         Xf, tf, wf = X, t, w
         if prior and n:
             Xf = np.concatenate([X, X], axis=0)
-            tf = np.concatenate([t, prior_times(X)])
+            tf = np.concatenate([t, prior_times(X, self.halo_width,
+                                                self.halo_itemsize)])
             wf = np.concatenate([w, np.full(n, PRIOR_WEIGHT)])
         self.w = _weighted_ridge(Xf, tf, wf, self.ridge)
         self.num_fits += 1
@@ -87,7 +99,7 @@ class OnlineCostModel:
         """Predicted per-part time [n]; the warm-start prior until fit."""
         X = np.atleast_2d(np.asarray(X, dtype=np.float64))
         if self.w is None:
-            return prior_times(X)
+            return prior_times(X, self.halo_width, self.halo_itemsize)
         return np.maximum(X @ self.w, 0.0)
 
     def search_weights(self) -> np.ndarray:
@@ -99,7 +111,8 @@ class OnlineCostModel:
             # Prior in weight form: per-edge + per-row chunk rate, halo bytes.
             from roc_tpu.ops.pallas.binned import _MM_CHUNK_S
             from roc_tpu.ops.pallas.segment_sum import EB, VB
-            halo = _PRIOR_HALO_WIDTH * 4.0 / _PRIOR_ICI_BYTES_PER_S
+            halo = (self.halo_width * float(self.halo_itemsize)
+                    / _PRIOR_ICI_BYTES_PER_S)
             return np.array([_MM_CHUNK_S / VB, _MM_CHUNK_S / EB,
                              halo, halo, 0.0])
         w = self.w.copy()

@@ -95,9 +95,17 @@ class BalanceManager:
     """Per-trainer balancer state; one instance lives for the whole run."""
 
     def __init__(self, min_gain: float = 0.05, trace_path: str = "",
-                 telemetry: Optional[TelemetryBuffer] = None):
+                 telemetry: Optional[TelemetryBuffer] = None,
+                 halo_width: int = 0, halo_itemsize: int = 0):
         self.min_gain = float(min_gain)
-        self.model = OnlineCostModel()
+        # 0 = "caller didn't thread the run's shape" — the cost model keeps
+        # its probe-width fp32 fallback for the warm-start prior.
+        kw = {}
+        if halo_width:
+            kw["halo_width"] = int(halo_width)
+        if halo_itemsize:
+            kw["halo_itemsize"] = int(halo_itemsize)
+        self.model = OnlineCostModel(**kw)
         # `is not None`, not `or`: an empty TelemetryBuffer is falsy (len 0).
         self.telemetry = (telemetry if telemetry is not None
                           else TelemetryBuffer(trace_path=trace_path))
@@ -106,9 +114,11 @@ class BalanceManager:
         self.events: List[dict] = []
 
     @classmethod
-    def from_config(cls, cfg) -> "BalanceManager":
+    def from_config(cls, cfg, halo_width: int = 0,
+                    halo_itemsize: int = 0) -> "BalanceManager":
         return cls(min_gain=cfg.balance_min_gain,
-                   trace_path=cfg.balance_trace)
+                   trace_path=cfg.balance_trace,
+                   halo_width=halo_width, halo_itemsize=halo_itemsize)
 
     # -- the four stages --------------------------------------------------
     def collect(self, part: Partition, graph: Csr, epoch: int

@@ -110,6 +110,15 @@ def _bind(L: ctypes.CDLL) -> None:
     L.roc_binned_flat_plan_fill_g.argtypes = [i64p, i64p, i64p] + \
         [ctypes.c_int64] * 7 + [i32p] * 8
     L.roc_binned_flat_plan_fill_g.restype = ctypes.c_int
+    # geo6 (unit-aware) flat-builder entry points: a stale .so without
+    # them raises AttributeError here, which lib() turns into the NumPy
+    # fallback — never a silently wrong unit.
+    L.roc_binned_flat_plan_sizes_g2.argtypes = [i64p, i64p, i64p] + \
+        [ctypes.c_int64] * 4 + [i64p]
+    L.roc_binned_flat_plan_sizes_g2.restype = ctypes.c_int
+    L.roc_binned_flat_plan_fill_g2.argtypes = [i64p, i64p, i64p] + \
+        [ctypes.c_int64] * 7 + [i32p] * 8
+    L.roc_binned_flat_plan_fill_g2.restype = ctypes.c_int
     L.roc_rcm_order.argtypes = [i64p, i32p, i64p, i32p, ctypes.c_int64,
                                 i64p]
     L.roc_rcm_order.restype = ctypes.c_int
@@ -322,13 +331,15 @@ def binned_flat_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     L = lib()
     assert L is not None
     CH, CH2, KD = geom.ch, geom.ch2, geom.kd
-    geo5 = np.asarray(tuple(geom)[:5], np.int64)
+    # geo6 = geo5 + unit rows (0 keeps the library's 8-row default; 16
+    # selects the bf16 tile-aligned unit)
+    geo6 = np.asarray(tuple(geom)[:5] + (geom.unit,), np.int64)
     src = np.ascontiguousarray(edge_src, np.int64)
     dst = np.ascontiguousarray(edge_dst, np.int64)
     E = len(src)
     out4 = np.zeros(4, np.int64)
-    rc = L.roc_binned_flat_plan_sizes_g(geo5, src, dst, E, num_rows,
-                                        table_rows, group_row_target, out4)
+    rc = L.roc_binned_flat_plan_sizes_g2(geo6, src, dst, E, num_rows,
+                                         table_rows, group_row_target, out4)
     if rc != 0:
         raise RuntimeError(f"roc_binned_flat_plan_sizes rc={rc}")
     G, C1, C2, bpg = (int(v) for v in out4)
@@ -340,11 +351,11 @@ def binned_flat_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     p2_dstl = np.empty(G * C2 * CH2, np.int32)
     p2_obi = np.empty(G * C2, np.int32)
     p2_first = np.empty(G * C2, np.int32)
-    rc = L.roc_binned_flat_plan_fill_g(geo5, src, dst, E, num_rows,
-                                       table_rows, group_row_target, G, C1,
-                                       C2, p1_srcl, p1_blk, p1_blk2,
-                                       p1_dsrc, p1_ddst, p2_dstl, p2_obi,
-                                       p2_first)
+    rc = L.roc_binned_flat_plan_fill_g2(geo6, src, dst, E, num_rows,
+                                        table_rows, group_row_target, G, C1,
+                                        C2, p1_srcl, p1_blk, p1_blk2,
+                                        p1_dsrc, p1_ddst, p2_dstl, p2_obi,
+                                        p2_first)
     if rc != 0:
         raise RuntimeError(f"roc_binned_flat_plan_fill rc={rc}")
     return (p1_srcl.reshape(G, C1 * CH), p1_blk.reshape(G, C1),

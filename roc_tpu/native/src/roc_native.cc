@@ -605,12 +605,13 @@ int roc_binned_plan_fill(const int64_t* src, const int64_t* dst, int64_t E,
 
 // ---------------------------------------------------------------------------
 // Flat-schedule binned plan (binned.py _build_flat_plan_numpy mirror).
-// Cells pad to BN_UNIT(=8)-row units; each group's per-block unit streams
+// Cells pad to unit-row units (BN_UNIT=8 for fp32 staging; 16 for the
+// bf16 tile-aligned variant, geo6[5]); each group's per-block unit streams
 // pack back-to-back into CH-row chunks (a chunk may span at most TWO
 // blocks — early cut when a third would enter a partly-filled chunk); the
 // slot-offset table becomes per-chunk run lists of size-classed staging
-// copies (128/32/8 rows), KD = CH/8 entries max per chunk.  Phase 2 keeps
-// the slot builder's layout with units instead of slots.  Must stay
+// copies (16/4/1 units), KD = CH/unit entries max per chunk.  Phase 2
+// keeps the slot builder's layout with units instead of slots.  Must stay
 // element-identical to the NumPy builder (test_native_flat_plan_equals_numpy).
 // ---------------------------------------------------------------------------
 
@@ -618,18 +619,30 @@ static const int64_t BN_UNIT = 8;                      // binned.py _UNIT
 static const int64_t BN_DMA_CLS[3] = {16, 4, 1};       // binned.py _DMA_CLS
 
 struct BnFlatGeo {
-  int64_t sb, ch, rb, ch2, uc, u2, kd;
+  int64_t sb, ch, rb, ch2, unit, uc, u2, kd;
 };
+
+static int bn_flat_geo_units(BnFlatGeo* g, int64_t unit) {
+  if (unit != 8 && unit != 16) return -1;
+  g->unit = unit;
+  if (g->sb < 1 || g->rb < 1) return -1;
+  if (g->ch < unit || g->ch % unit) return -1;
+  if (g->ch2 < unit || g->ch2 % unit) return -1;
+  g->uc = g->ch / unit;
+  g->u2 = g->ch2 / unit;
+  g->kd = g->ch / unit;
+  return 0;
+}
 
 static int bn_flat_geo_from(const int64_t* geo5, BnFlatGeo* g) {
   g->sb = geo5[0]; g->ch = geo5[1]; g->rb = geo5[3]; g->ch2 = geo5[4];
-  if (g->sb < 1 || g->rb < 1) return -1;
-  if (g->ch < BN_UNIT || g->ch % BN_UNIT) return -1;
-  if (g->ch2 < BN_UNIT || g->ch2 % BN_UNIT) return -1;
-  g->uc = g->ch / BN_UNIT;
-  g->u2 = g->ch2 / BN_UNIT;
-  g->kd = g->ch / BN_UNIT;
-  return 0;
+  return bn_flat_geo_units(g, BN_UNIT);
+}
+
+// geo6 = (sb, ch, slot, rb, ch2, unit); unit 0 means the BN_UNIT default.
+static int bn_flat_geo_from6(const int64_t* geo6, BnFlatGeo* g) {
+  g->sb = geo6[0]; g->ch = geo6[1]; g->rb = geo6[3]; g->ch2 = geo6[4];
+  return bn_flat_geo_units(g, geo6[5] ? geo6[5] : BN_UNIT);
 }
 
 static int bn_flat_build(const BnFlatGeo& geo, const int64_t* src,
@@ -641,7 +654,7 @@ static int bn_flat_build(const BnFlatGeo& geo, const int64_t* src,
                          int32_t* p1_blk2, int32_t* p1_dsrc,
                          int32_t* p1_ddst, int32_t* p2_dstl,
                          int32_t* p2_obi, int32_t* p2_first) {
-  const int64_t U = BN_UNIT;
+  const int64_t U = geo.unit;
   BnGeo pgeo;  // bn_params only reads sb/rb
   pgeo.sb = geo.sb; pgeo.rb = geo.rb;
   int64_t num_bins, num_blocks, bpg, G;
@@ -825,34 +838,25 @@ static int bn_flat_build(const BnFlatGeo& geo, const int64_t* src,
   return 0;
 }
 
-int roc_binned_flat_plan_sizes_g(const int64_t* geo5, const int64_t* src,
-                                 const int64_t* dst, int64_t E,
-                                 int64_t num_rows, int64_t table_rows,
-                                 int64_t group_row_target, int64_t* out4) {
-  BnFlatGeo geo;
-  if (bn_flat_geo_from(geo5, &geo) != 0) return -2;
+static int bn_flat_sizes_impl(const BnFlatGeo& geo, const int64_t* src,
+                              const int64_t* dst, int64_t E,
+                              int64_t num_rows, int64_t table_rows,
+                              int64_t group_row_target, int64_t* out4) {
   return bn_flat_build(geo, src, dst, E, num_rows, table_rows,
                        group_row_target, &out4[0], &out4[1], &out4[2],
                        &out4[3], 0, 0, nullptr, nullptr, nullptr, nullptr,
                        nullptr, nullptr, nullptr, nullptr);
 }
 
-// Caller allocates: p1_srcl [G*C1*CH], p1_blk [G*C1], p1_blk2 [G*C1],
-// p1_dsrc [G*C1*KD], p1_ddst [G*C1*KD] (KD = CH/8), p2_dstl [G*C2*CH2],
-// p2_obi [G*C2], p2_first [G*C2].  This call pre-fills the pad values
-// (srcl/dsrc/ddst -1, blk/blk2 0, dstl RB).  Returns 0, -1 on geometry
-// mismatch, -2 on invalid geometry, -3 on run-list overflow.
-int roc_binned_flat_plan_fill_g(const int64_t* geo5, const int64_t* src,
-                                const int64_t* dst, int64_t E,
-                                int64_t num_rows, int64_t table_rows,
-                                int64_t group_row_target, int64_t G,
-                                int64_t C1, int64_t C2, int32_t* p1_srcl,
-                                int32_t* p1_blk, int32_t* p1_blk2,
-                                int32_t* p1_dsrc, int32_t* p1_ddst,
-                                int32_t* p2_dstl, int32_t* p2_obi,
-                                int32_t* p2_first) {
-  BnFlatGeo geo;
-  if (bn_flat_geo_from(geo5, &geo) != 0) return -2;
+static int bn_flat_fill_impl(const BnFlatGeo& geo, const int64_t* src,
+                             const int64_t* dst, int64_t E,
+                             int64_t num_rows, int64_t table_rows,
+                             int64_t group_row_target, int64_t G,
+                             int64_t C1, int64_t C2, int32_t* p1_srcl,
+                             int32_t* p1_blk, int32_t* p1_blk2,
+                             int32_t* p1_dsrc, int32_t* p1_ddst,
+                             int32_t* p2_dstl, int32_t* p2_obi,
+                             int32_t* p2_first) {
   std::fill(p1_srcl, p1_srcl + G * C1 * geo.ch, -1);
   std::fill(p1_blk, p1_blk + G * C1, 0);
   std::fill(p1_blk2, p1_blk2 + G * C1, 0);
@@ -869,6 +873,67 @@ int roc_binned_flat_plan_fill_g(const int64_t* geo5, const int64_t* src,
   if (rc != 0) return rc;
   if (g2 != G || c1 > C1 || c2 > C2) return -1;
   return 0;
+}
+
+int roc_binned_flat_plan_sizes_g(const int64_t* geo5, const int64_t* src,
+                                 const int64_t* dst, int64_t E,
+                                 int64_t num_rows, int64_t table_rows,
+                                 int64_t group_row_target, int64_t* out4) {
+  BnFlatGeo geo;
+  if (bn_flat_geo_from(geo5, &geo) != 0) return -2;
+  return bn_flat_sizes_impl(geo, src, dst, E, num_rows, table_rows,
+                            group_row_target, out4);
+}
+
+// geo6 variant: geo6[5] is the unit-row count (0/8 = fp32 staging,
+// 16 = the bf16 tile-aligned unit).
+int roc_binned_flat_plan_sizes_g2(const int64_t* geo6, const int64_t* src,
+                                  const int64_t* dst, int64_t E,
+                                  int64_t num_rows, int64_t table_rows,
+                                  int64_t group_row_target, int64_t* out4) {
+  BnFlatGeo geo;
+  if (bn_flat_geo_from6(geo6, &geo) != 0) return -2;
+  return bn_flat_sizes_impl(geo, src, dst, E, num_rows, table_rows,
+                            group_row_target, out4);
+}
+
+// Caller allocates: p1_srcl [G*C1*CH], p1_blk [G*C1], p1_blk2 [G*C1],
+// p1_dsrc [G*C1*KD], p1_ddst [G*C1*KD] (KD = CH/unit), p2_dstl [G*C2*CH2],
+// p2_obi [G*C2], p2_first [G*C2].  This call pre-fills the pad values
+// (srcl/dsrc/ddst -1, blk/blk2 0, dstl RB).  Returns 0, -1 on geometry
+// mismatch, -2 on invalid geometry, -3 on run-list overflow.
+int roc_binned_flat_plan_fill_g(const int64_t* geo5, const int64_t* src,
+                                const int64_t* dst, int64_t E,
+                                int64_t num_rows, int64_t table_rows,
+                                int64_t group_row_target, int64_t G,
+                                int64_t C1, int64_t C2, int32_t* p1_srcl,
+                                int32_t* p1_blk, int32_t* p1_blk2,
+                                int32_t* p1_dsrc, int32_t* p1_ddst,
+                                int32_t* p2_dstl, int32_t* p2_obi,
+                                int32_t* p2_first) {
+  BnFlatGeo geo;
+  if (bn_flat_geo_from(geo5, &geo) != 0) return -2;
+  return bn_flat_fill_impl(geo, src, dst, E, num_rows, table_rows,
+                           group_row_target, G, C1, C2, p1_srcl, p1_blk,
+                           p1_blk2, p1_dsrc, p1_ddst, p2_dstl, p2_obi,
+                           p2_first);
+}
+
+int roc_binned_flat_plan_fill_g2(const int64_t* geo6, const int64_t* src,
+                                 const int64_t* dst, int64_t E,
+                                 int64_t num_rows, int64_t table_rows,
+                                 int64_t group_row_target, int64_t G,
+                                 int64_t C1, int64_t C2, int32_t* p1_srcl,
+                                 int32_t* p1_blk, int32_t* p1_blk2,
+                                 int32_t* p1_dsrc, int32_t* p1_ddst,
+                                 int32_t* p2_dstl, int32_t* p2_obi,
+                                 int32_t* p2_first) {
+  BnFlatGeo geo;
+  if (bn_flat_geo_from6(geo6, &geo) != 0) return -2;
+  return bn_flat_fill_impl(geo, src, dst, E, num_rows, table_rows,
+                           group_row_target, G, C1, C2, p1_srcl, p1_blk,
+                           p1_blk2, p1_dsrc, p1_ddst, p2_dstl, p2_obi,
+                           p2_first);
 }
 
 void roc_in_degrees(const uint64_t* raw_rows, uint64_t num_nodes,

@@ -332,7 +332,8 @@ class BinnedPlans(NamedTuple):
 
 def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
                        num_rows: int, table_rows: int,
-                       geom=None) -> BinnedPlans:
+                       geom=None,
+                       storage_dtype: str = "fp32") -> BinnedPlans:
     """Schedules for out = A@x (fwd) and grad_x = A^T@grad (bwd) — the bwd
     plan swaps roles exactly as the reference re-launches its forward
     kernel transposed (scattergather_kernel.cu:160-170).
@@ -361,7 +362,8 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
     def pick(spec, src, dst, n, t):
         if spec != "auto":
             return spec
-        g, _ = choose_geometry(src, dst, n, t, force=True)
+        g, _ = choose_geometry(src, dst, n, t, force=True,
+                               storage_dtype=storage_dtype)
         return g or _default_geom()
 
     fwd_geom = pick(fwd_spec, edge_src, edge_dst, num_rows, table_rows)

@@ -89,8 +89,12 @@ CH2 = 4096    # staging rows per phase-2 chunk
 # (8, 128), so 8-row cell padding is the finest the DMA engine can move
 # without tearing tiles — and it is what gets pad1 under 1.05 at Reddit
 # shape (avg cell ~113 edges: 8-row padding wastes ~3.3%, SLOT=128 wastes
-# 43%).  Flat staging is therefore ALWAYS fp32: a bf16 tile is (16, 128)
-# and an 8-row slice of it is sublane-misaligned.
+# 43%).  Flat staging at this default unit is therefore fp32: a bf16 tile
+# is (16, 128) and an 8-row slice of it is sublane-misaligned.  The
+# bf16-storage pipeline (round 9) instead sets Geometry.unit=16 — cells
+# pad to one whole bf16 sublane tile, every size-classed copy stays
+# tile-aligned, and staging rides bf16 (halving the DMA bytes for ~2x the
+# cell-padding tax: ~6.6% vs ~3.3% at Reddit's ~113-edge cells).
 _UNIT = 8
 # Staging-copy size classes for the flat schedule, in _UNIT-row units:
 # each per-(chunk, staging) run of consecutive rows decomposes greedily
@@ -142,13 +146,22 @@ class Geometry(NamedTuple):
     # (cells pad to _UNIT=8 rows instead of SLOT; a chunk may span two
     # source blocks; staging writes become per-run size-classed DMAs from
     # scalar-prefetched metadata), eliminating the per-(group, block)
-    # chunk rounding that made pad1=1.43 at Reddit shape.  Staging rides
-    # fp32 at both precisions — an 8-row slice of a bf16 (16, 128)-tiled
-    # buffer is sublane-misaligned, so the finer granularity buys its
-    # padding win with 2x staging DMA bytes (hardware-window question;
-    # docs/DESIGN.md §Flat schedule).  MUST stay the last field: native
-    # plan builders and the sweep tooling consume tuple(geom)[:5].
+    # chunk rounding that made pad1=1.43 at Reddit shape.  At the default
+    # 8-row unit staging rides fp32 at both precisions — an 8-row slice of
+    # a bf16 (16, 128)-tiled buffer is sublane-misaligned, so the finer
+    # granularity buys its padding win with 2x staging DMA bytes
+    # (hardware-window question; docs/DESIGN.md §Flat schedule, §Precision).
     flat: int = 0
+    # Flat-schedule unit rows (0 = the module default _UNIT=8, fp32
+    # staging).  unit=16 is the bf16-storage variant (round 9): cells pad
+    # to one whole bf16 (16, 128) sublane tile, so staging and the
+    # size-classed copies ride bf16 — half the DMA bytes of the fp32
+    # 8-row unit for ~2x its cell-padding tax.  Only flat geometries use
+    # it; "exact" precision needs fp32 staging and run_binned rejects the
+    # combination.  New fields MUST append after this one: native plan
+    # builders and the sweep tooling consume tuple(geom)[:5], and the
+    # plan-cache key/version hash the whole tuple.
+    unit: int = 0
 
     @property
     def nslot(self) -> int:
@@ -159,10 +172,16 @@ class Geometry(NamedTuple):
         return self.ch2 // self.slot
 
     @property
+    def unit_rows(self) -> int:
+        """Flat-schedule staging granularity, rows (module default when
+        the field is 0)."""
+        return self.unit or _UNIT
+
+    @property
     def kd(self) -> int:
         """Flat-schedule DMA descriptor slots per chunk: worst case one
-        copy per _UNIT-row unit."""
-        return self.ch // _UNIT
+        copy per unit-row unit."""
+        return self.ch // self.unit_rows
 
     @property
     def group_rows(self) -> int:
@@ -174,8 +193,13 @@ class Geometry(NamedTuple):
             f"slot must be a positive multiple of 16: {self}"
         assert self.ch >= self.slot and self.ch % self.slot == 0, self
         assert self.ch2 >= self.slot and self.ch2 % self.slot == 0, self
+        assert self.unit in (0, 16), \
+            f"unit must be 0 (fp32 8-row) or 16 (bf16 tile): {self}"
+        if self.unit:
+            assert self.flat, f"unit is a flat-schedule field: {self}"
         if self.flat:
-            assert self.ch % _UNIT == 0 and self.ch2 % _UNIT == 0, self
+            u = self.unit_rows
+            assert self.ch % u == 0 and self.ch2 % u == 0, self
         return self
 
 
@@ -238,6 +262,15 @@ GEOM_FLAT = Geometry(sb=512, ch=4096, slot=128, rb=512, ch2=4096, flat=1)
 GEOM_FLAT_SPARSE = Geometry(sb=1024, ch=2048, slot=16, rb=1024, ch2=2048,
                             flat=1)
 
+# bf16-storage flat variants (round 9, docs/DESIGN.md §Precision): 16-row
+# units keep every staging copy aligned to the bf16 (16, 128) tile, so the
+# staging buffer and its DMAs ride bf16 — half the bytes of the fp32 8-row
+# unit.  choose_geometry only considers these when the caller declares
+# bf16 storage (the driver's Config.bf16_storage / use_bf16 path); fp32
+# runs never trade cell padding for a byte win they can't bank.
+GEOM_FLAT_BF16 = GEOM_FLAT._replace(unit=16)
+GEOM_FLAT_SPARSE_BF16 = GEOM_FLAT_SPARSE._replace(unit=16)
+
 # Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).  Fewer
 # groups = less per-(group, block) chunk-rounding padding in phase 1 at the
 # cost of a proportionally larger staging buffer; ROC_BINNED_GROUP_ROWS
@@ -268,7 +301,8 @@ class BinnedPlan:
       p1_blk2 [G, C1]        secondary x block (== p1_blk if none)
       p1_dsrc [G, C1, KD]    staging-copy source:  cls<<16 | chunk unit
                              (cls indexes _DMA_CLS; -1 = unused slot)
-      p1_ddst [G, C1, KD]    staging-copy destination unit (row/_UNIT)
+      p1_ddst [G, C1, KD]    staging-copy destination unit
+                             (row / geom.unit_rows)
     Fused plans additionally carry a flattened interleaved step list
     (phase 2 of group g overlapped with phase 1 of group g+1; built by
     _attach_fused when the whole group's staging fits VMEM, else None):
@@ -320,6 +354,25 @@ jax.tree_util.register_dataclass(
 
 def _pad_to(n: int, m: int) -> int:
     return -(-n // m) * m
+
+
+def staging_dtype(geom: Geometry, exact: bool):
+    """The staging-buffer dtype a plan geometry implies at a precision —
+    THE single decision point every byte consumer (kernels, VMEM gates,
+    cost model, memory estimator, kernel budgets) shares.
+
+    Slot schedule: bf16 for "fast", fp32 for "exact" (the original
+    contract).  Flat schedule: a pure function of the geometry — fp32 at
+    the default 8-row unit (tears bf16 tiles), bf16 at unit=16; "exact"
+    needs fp32 staging, so run_binned rejects exact on unit=16 plans
+    rather than silently widening."""
+    if geom is not None and geom.flat:
+        return jnp.bfloat16 if geom.unit == 16 else jnp.float32
+    return jnp.float32 if exact else jnp.bfloat16
+
+
+def staging_itemsize(geom: Geometry, exact: bool) -> int:
+    return np.dtype(staging_dtype(geom, exact)).itemsize
 
 
 def binned_viable(num_rows: int, table_rows: int, num_edges: int,
@@ -396,11 +449,13 @@ def _matmul_cost(num_edges: int, num_rows: int) -> float:
 def _vmem_bytes(geom: Geometry, H: int = _MODEL_H,
                 exact: bool = False) -> int:
     if geom.flat:
-        # Flat staging is fp32 at BOTH precisions (8-row units tear bf16
-        # (16, 128) tiles); phase 1 streams TWO x blocks per chunk.
-        p1 = (geom.ch * geom.sb * 2 + 2 * geom.ch * H * 4
+        # Flat staging dtype is a function of the geometry's unit (fp32 at
+        # 8 rows — they tear bf16 (16, 128) tiles — bf16 at unit=16);
+        # phase 1 streams TWO x blocks per chunk.
+        stg = staging_itemsize(geom, exact)
+        p1 = (geom.ch * geom.sb * 2 + 2 * geom.ch * H * stg
               + 2 * geom.sb * H * 4)
-        p2 = (geom.ch2 * geom.rb * 2 + geom.ch2 * H * 4
+        p2 = (geom.ch2 * geom.rb * 2 + geom.ch2 * H * stg
               + geom.rb * H * 4)
         return max(p1, p2)
     stg = 4 if exact else 2
@@ -433,12 +488,15 @@ def _binned_cost_model(padded_rows: int, geom: Geometry,
     if geom.flat:
         # Flat staging writes are per-run size-classed DMAs, not per-slot:
         # a typical cell (~1 run) moves in a few descriptors.  Modeled at
-        # an average 4-unit (32-row) copy, fp32 so 2x the bytes — both
-        # constants to be re-fit from the next hardware window
-        # (ROADMAP standing item; the policy and the grid test price
-        # candidates through this same branch, so the ranking is
+        # an average 4-unit copy, scaled by the staging itemsize relative
+        # to the bf16 slot schedule the constant was fit on (fp32 8-row
+        # units pay 2x the bytes; bf16 16-row units pay 1x on half the
+        # descriptors) — constants to be re-fit from the next hardware
+        # window (ROADMAP standing item; the policy and the grid test
+        # price candidates through this same branch, so the ranking is
         # self-consistent either way).
-        dma1 = padded_rows / (_UNIT * 4) * _SLOT_DMA_S * 2
+        dma1 = (padded_rows / (geom.unit_rows * 4) * _SLOT_DMA_S
+                * (staging_itemsize(geom, False) / 2))
     else:
         dma1 = padded_rows / geom.slot * _SLOT_DMA_S
     return max(mac1, ov1) + dma1 + max(mac2, ov2)
@@ -479,7 +537,7 @@ def _flat_pack(stream_g: np.ndarray, stream_units: np.ndarray,
                uc: int, G: int, segments: bool = False):
     """Flat-schedule phase-1 packer: lay each group's (source-block-major)
     unit streams into `uc`-unit chunks.  One stream = one (group, block)
-    pair's _UNIT-row units, in cell order.  A chunk may span at most TWO
+    pair's ``geom.unit_rows``-row units, in cell order.  A chunk may span at most TWO
     streams — the kernel reads two x blocks per grid step — so when a
     third block would enter a partly-filled chunk the chunk is cut early;
     that cut and each group's final partial chunk are the only schedule
@@ -526,19 +584,20 @@ def _flat_pack(stream_g: np.ndarray, stream_units: np.ndarray,
 
 def _flat_plan_steps(cell_blk, cell_bin, cnt, geom, num_bins, num_blocks,
                      bpg, G):
-    """Flat-schedule arm of _plan_steps: cells pad to _UNIT rows, phase-1
+    """Flat-schedule arm of _plan_steps: cells pad to unit_rows, phase-1
     chunks pack via _flat_pack, phase-2 bins pad to whole CH2 chunks."""
-    cell_units = -(-cnt // _UNIT)
-    padded = int(cell_units.sum() * _UNIT)
+    U = geom.unit_rows
+    cell_units = -(-cnt // U)
+    padded = int(cell_units.sum() * U)
     # phase 1: streams in (group, block) order — np.unique sorts the key
     gb = (cell_bin // bpg) * num_blocks + cell_blk
     gb_uniq, gb_inv = np.unique(gb, return_inverse=True)
     gb_units = np.bincount(gb_inv, weights=cell_units).astype(np.int64)
     c1_per_g, _ = _flat_pack(gb_uniq // num_blocks, gb_units,
-                             geom.ch // _UNIT, G)
+                             geom.ch // U, G)
     C1 = _pad_to(max(int(c1_per_g.max(initial=0)), 1), 8)
     # phase 2: bins stay CH2-aligned in staging (empty bins cost one chunk)
-    u2 = geom.ch2 // _UNIT
+    u2 = geom.ch2 // U
     bin_units = np.bincount(cell_bin, weights=cell_units,
                             minlength=num_bins).astype(np.int64)
     bin_chunks = np.maximum(-(-bin_units // u2), 1)
@@ -595,13 +654,27 @@ def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,
     it never touches."""
     cnt = _cell_counts(edge_src, edge_dst, geom.sb, geom.rb)
     if geom.flat:
-        return int((-(-cnt // _UNIT)).sum() * _UNIT)
+        U = geom.unit_rows
+        return int((-(-cnt // U)).sum() * U)
     return int((-(-cnt // geom.slot)).sum() * geom.slot)
+
+
+def staging_bytes_for(edge_src: np.ndarray, edge_dst: np.ndarray,
+                      geom: Geometry, H: int = _MODEL_H,
+                      exact: bool = False) -> int:
+    """Predicted staging-DMA bytes for ONE aggregation pass: every padded
+    staging row is written once by phase 1 and read once by phase 2, at
+    the geometry's staging dtype.  The byte axis the kernel-budget gate
+    pins (tools/check_kernel_budgets.py): a bf16-unit flat geometry must
+    move ~half the bytes of its fp32 twin at the same windows."""
+    return (2 * padded_rows_for(edge_src, edge_dst, geom) * H
+            * staging_itemsize(geom, exact))
 
 
 def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
                     num_rows: int, table_rows: int,
-                    candidates=None, force: bool = False):
+                    candidates=None, force: bool = False,
+                    storage_dtype: str = "fp32"):
     """Pick the fastest-modeled binned geometry for this graph, or None if
     the matmul backend's modeled cost beats every candidate (VERDICT r3
     item 3: products-density graphs get a measured-stats policy instead of
@@ -619,14 +692,25 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     the seconds then model matmul).  ``force=True`` always returns the best
     binned candidate — the explicit `-aggr-backend binned` path, where
     falling back to the dense default geometry on a sparse graph would
-    build a multi-GB plan."""
+    build a multi-GB plan.
+
+    ``storage_dtype``: "fp32" (default) or "bf16" — the feature-storage
+    dtype the trainer will run.  bf16 storage adds the 16-row bf16-unit
+    flat presets to the candidate list (their halved staging bytes only
+    exist when the input rides bf16; an fp32 run gains nothing and would
+    pay the doubled cell padding)."""
     E = len(edge_src)
     if E == 0:
         return None, 0.0
+    if storage_dtype not in ("fp32", "bf16"):
+        raise ValueError(f"storage_dtype={storage_dtype!r}: must be "
+                         f"'fp32' or 'bf16'")
     cands = list(candidates) if candidates is not None else \
         [_default_geom(), GEOM_WIDE, GEOM_MID, GEOM_MID_WIDE,
          GEOM_SPARSE, GEOM_SPARSE_WIDE, GEOM_XSPARSE,
          GEOM_FLAT, GEOM_FLAT_SPARSE]
+    if candidates is None and storage_dtype == "bf16":
+        cands += [GEOM_FLAT_BF16, GEOM_FLAT_SPARSE_BF16]
     best, best_t = None, float("inf")
     stats_cache = {}
     for g in cands:
@@ -786,10 +870,10 @@ def _plan_cache_path(edge_src, edge_dst, num_rows, table_rows,
     h = hashlib.sha1()
     h.update(np.ascontiguousarray(edge_src, np.int64).tobytes())
     h.update(np.ascontiguousarray(edge_dst, np.int64).tobytes())
-    # v2: flat-schedule plans (Geometry.flat, p1_blk2/p1_dsrc/p1_ddst in
-    # the archive); the geometry tuple grew a field, so v1 files no longer
-    # match any key.
-    h.update(repr(("v2", num_rows, table_rows, group_row_target,
+    # v3: the geometry tuple grew the flat-unit field (bf16 staging), so
+    # v2 files no longer match any key — a bf16<->fp32 storage flip can
+    # never hit a stale plan.  (v2 was the flat-schedule field itself.)
+    h.update(repr(("v3", num_rows, table_rows, group_row_target,
                    tuple(geom))).encode())
     return os.path.join(base, f"binned_plan_{h.hexdigest()}.npz")
 
@@ -999,14 +1083,15 @@ def _build_flat_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,
                            group_row_target: int,
                            geom: Geometry) -> BinnedPlan:
     """Flat-schedule oracle builder (geom.flat): same sort and cell
-    machinery as the slot builder, but cells pad to _UNIT(=8)-row units,
+    machinery as the slot builder, but cells pad to unit_rows-row units
+    (8 for fp32 staging, 16 for the bf16 tile-aligned variant),
     phase-1 chunks pack back-to-back across a group's (block) streams via
     _flat_pack (a chunk may span two source blocks), and the slot-offset
     table is replaced by per-chunk run lists of size-classed staging
     copies (p1_dsrc/p1_ddst, consumed via scalar prefetch).  Phase 2 keeps
     the existing kernel: bins stay CH2-aligned in staging, one bin per
     chunk."""
-    U = _UNIT
+    U = geom.unit_rows
     SB, CH, RB, CH2 = geom.sb, geom.ch, geom.rb, geom.ch2  # noqa: N806
     UC, U2, KD = CH // U, CH2 // U, geom.kd                # noqa: N806
     edge_src = np.asarray(edge_src, np.int64)
@@ -1416,18 +1501,21 @@ def _p1_run(x, blk, off, srcl, nchunks: int, stg_rows: int,
     )(blk, off, srcl, x)
 
 
-def _flat_copy(gbuf, stg_ref, sems, p, v, du, start: bool):
+def _flat_copy(gbuf, stg_ref, sems, p, v, du, start: bool,
+               unit: int = _UNIT):
     """One size-classed staging copy from a packed descriptor: v encodes
     cls<<16 | source unit, du is the destination unit.  Three static
-    branches — pl.ds sizes must be compile-time — of 128/32/8 rows."""
+    branches — pl.ds sizes must be compile-time — of 16/4/1 units
+    (128/32/8 rows fp32, 256/64/16 rows bf16; either way every slice is
+    whole sublane tiles of the staging dtype)."""
     cls = v // 65536
     su = v - cls * 65536
     for ci, csz in enumerate(_DMA_CLS):
         @pl.when(cls == ci)
         def _(csz=csz):
             cp = pltpu.make_async_copy(
-                gbuf.at[p].at[pl.ds(su * _UNIT, csz * _UNIT)],
-                stg_ref.at[pl.ds(du * _UNIT, csz * _UNIT)],
+                gbuf.at[p].at[pl.ds(su * unit, csz * unit)],
+                stg_ref.at[pl.ds(du * unit, csz * unit)],
                 sems.at[p])
             (cp.start if start else cp.wait)()
 
@@ -1446,6 +1534,8 @@ def _p1_flat_kernel(blk_ref, blk2_ref, dsrc_ref, ddst_ref, srcl_ref,
     c+2, with dbs/dbd keeping each parity's descriptors for the wait;
     pipeline=False is the ROC_BINNED_NO_PIPELINE bisection baseline."""
     CH, SB, KD = geom.ch, geom.sb, geom.kd                         # noqa
+    U = geom.unit_rows
+    st = staging_dtype(geom, exact)
     c = pl.program_id(0)
     par = c % 2 if pipeline else 0
 
@@ -1454,7 +1544,7 @@ def _p1_flat_kernel(blk_ref, blk2_ref, dsrc_ref, ddst_ref, srcl_ref,
             @pl.when(dbs[p, e] >= 0)
             def _():
                 _flat_copy(gbuf, stg_ref, sems, p, dbs[p, e], dbd[p, e],
-                           start=False)
+                           start=False, unit=U)
             return 0
         jax.lax.fori_loop(0, KD, drain, 0)
 
@@ -1466,15 +1556,17 @@ def _p1_flat_kernel(blk_ref, blk2_ref, dsrc_ref, ddst_ref, srcl_ref,
     lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
     sl = srcl_ref[:]
     t1 = (lane == sl).astype(jnp.bfloat16)
-    gbuf[par] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())), exact)
+    gbuf[par] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())),
+                            exact).astype(st)
 
     @pl.when(blk2_ref[c] != blk_ref[c])
     def _():
         # secondary-block rows (disjoint from the primary's by the
-        # +SB encoding, so the sum is exact row selection)
+        # +SB encoding, so the sum is exact row selection — each row is
+        # rounded to the staging dtype exactly once)
         t2 = (lane == sl - SB).astype(jnp.bfloat16)
-        gbuf[par] = gbuf[par] + _onehot_dot(
-            t2, x2_ref[:], (((1,), (0,)), ((), ())), exact)
+        gbuf[par] = (gbuf[par].astype(jnp.float32) + _onehot_dot(
+            t2, x2_ref[:], (((1,), (0,)), ((), ())), exact)).astype(st)
 
     # descriptors ride in (8, KD) SMEM blocks; this chunk's row is c % 8
     def issue(e, _):
@@ -1485,7 +1577,7 @@ def _p1_flat_kernel(blk_ref, blk2_ref, dsrc_ref, ddst_ref, srcl_ref,
         @pl.when(v >= 0)
         def _():
             _flat_copy(gbuf, stg_ref, sems, par, v, ddst_ref[c % 8, e],
-                       start=True)
+                       start=True, unit=U)
         return 0
     jax.lax.fori_loop(0, KD, issue, 0)
 
@@ -1524,16 +1616,18 @@ def _p1_flat_run(x, blk, blk2, dsrc, ddst, srcl, nchunks: int,
             pl.BlockSpec((SB, H), lambda c, blk, blk2: (blk2[c], 0)),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        # flat staging is fp32 at both precisions (8-row units tear bf16
-        # tiles); gbuf likewise
-        scratch_shapes=[pltpu.VMEM((2, CH, H), jnp.float32),
+        # flat staging dtype follows the geometry: fp32 for 8-row units
+        # (bf16 (16,128) tiles would tear), bf16 for the 16-row unit
+        # variant; gbuf matches so DMA src/dst dtypes agree
+        scratch_shapes=[pltpu.VMEM((2, CH, H), staging_dtype(geom, exact)),
                         pltpu.SMEM((2, KD), jnp.int32),
                         pltpu.SMEM((2, KD), jnp.int32),
                         pltpu.SemaphoreType.DMA((2,))],
     )
     return pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((stg_rows, H), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((stg_rows, H),
+                                       staging_dtype(geom, exact)),
         interpret=interpret,
     )(blk, blk2, dsrc, ddst, srcl, x, x)
 
@@ -1602,6 +1696,8 @@ def _fused_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref, ddst_ref,
     revisited after writeback; every bin opens with first=1, which zeroes
     the fetched garbage."""
     CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    U = geom.unit_rows
+    st = staging_dtype(geom, exact)
     c = pl.program_id(0)
     kind = meta_ref[c % 8, 0]
     par = meta_ref[c % 8, 1]
@@ -1614,13 +1710,13 @@ def _fused_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref, ddst_ref,
         sl = rows_ref[:]
         t1 = (lane == sl).astype(jnp.bfloat16)
         gbuf[:] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())),
-                              exact)
+                              exact).astype(st)
 
         @pl.when(blk2_ref[c] != blk_ref[c])
         def _():
             t2 = (lane == sl - SB).astype(jnp.bfloat16)
-            gbuf[:] = gbuf[:] + _onehot_dot(
-                t2, x2_ref[:], (((1,), (0,)), ((), ())), exact)
+            gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                t2, x2_ref[:], (((1,), (0,)), ((), ())), exact)).astype(st)
 
         # VMEM->VMEM staging copies: issue all, drain all within the step
         # (the overlap is across phases here, not across copies)
@@ -1636,9 +1732,9 @@ def _fused_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref, ddst_ref,
                     @pl.when(cls == ci)
                     def _(csz=csz):
                         pltpu.make_async_copy(
-                            gbuf.at[pl.ds(su * _UNIT, csz * _UNIT)],
+                            gbuf.at[pl.ds(su * U, csz * U)],
                             stgbuf.at[par].at[
-                                pl.ds(du * _UNIT, csz * _UNIT)],
+                                pl.ds(du * U, csz * U)],
                             sems.at[0]).start()
             return 0
         jax.lax.fori_loop(0, KD, issue, 0)
@@ -1655,9 +1751,9 @@ def _fused_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref, ddst_ref,
                     @pl.when(cls == ci)
                     def _(csz=csz):
                         pltpu.make_async_copy(
-                            gbuf.at[pl.ds(su * _UNIT, csz * _UNIT)],
+                            gbuf.at[pl.ds(su * U, csz * U)],
                             stgbuf.at[par].at[
-                                pl.ds(du * _UNIT, csz * _UNIT)],
+                                pl.ds(du * U, csz * U)],
                             sems.at[0]).wait()
             return 0
         jax.lax.fori_loop(0, KD, drain, 0)
@@ -1700,8 +1796,9 @@ def _fused_run(x, blk, blk2, obi, meta, dsrc, ddst, rows, nsteps: int,
             pl.BlockSpec((SB, H), lambda c, b, b2, o: (b2[c], 0)),
         ],
         out_specs=pl.BlockSpec((RB, H), lambda c, b, b2, o: (o[c], 0)),
-        scratch_shapes=[pltpu.VMEM((CH, H), jnp.float32),
-                        pltpu.VMEM((2, srows, H), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((CH, H), staging_dtype(geom, exact)),
+                        pltpu.VMEM((2, srows, H),
+                                   staging_dtype(geom, exact)),
                         pltpu.SemaphoreType.DMA((1,))],
     )
     return pl.pallas_call(
@@ -1717,7 +1814,8 @@ def _fused_vmem_ok(geom: Geometry, Hp: int, c2: int) -> bool:
     this width: both staging parities + gbuf + the one-hot intermediates
     + two x blocks + the out window must fit the VMEM budget."""
     srows = c2 * geom.ch2
-    need = (2 * srows * Hp * 4 + geom.ch * Hp * 4
+    stg = staging_itemsize(geom, False)
+    need = (2 * srows * Hp * stg + geom.ch * Hp * stg
             + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
             + 2 * geom.sb * Hp * 4 + geom.rb * Hp * 4)
     return need <= _VMEM_BUDGET
@@ -1768,6 +1866,13 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False,
     H = x.shape[-1]
     Hp = _pad_to(H, 128)
     geom = plan.geom or _default_geom()
+    if exact and geom.flat and geom.unit == 16:
+        # the 16-row unit exists only to make bf16 staging tile-legal;
+        # routing fp32-exact through it would round every staged row
+        raise ValueError(
+            "precision='exact' is incompatible with a unit=16 (bf16 "
+            "staging) flat geometry: pick a unit=0 flat preset or "
+            "precision='fast'")
     G, C1 = plan.p1_blk.shape
     C2 = plan.p2_obi.shape[1]
     xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, geom.sb) - x.shape[0]),

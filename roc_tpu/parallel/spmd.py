@@ -79,6 +79,16 @@ class ShardedGraphData:
                                   metadata={"static": True})
     precision: str = dataclasses.field(default="exact",
                                        metadata={"static": True})
+    # Wire format for feature exchanges over ICI (_wire_down/_wire_up).
+    # Static metadata on purpose: it changes tree_structure(gd), so the
+    # SPMD step cache (_build_steps sig) can never serve a jitted step
+    # traced for the other dtype.
+    xch_dtype: str = dataclasses.field(default="fp32",
+                                       metadata={"static": True})
+    xch_round: str = dataclasses.field(default="nearest",
+                                       metadata={"static": True})
+    xch_comp: str = dataclasses.field(default="plain",
+                                      metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
@@ -86,7 +96,8 @@ jax.tree_util.register_dataclass(
     data_fields=["edge_src", "edge_dst", "in_degree", "send_idx",
                  "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans",
                  "plans_local", "plans_remote"],
-    meta_fields=["backend", "mode", "precision"])
+    meta_fields=["backend", "mode", "precision", "xch_dtype", "xch_round",
+                 "xch_comp"])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -527,7 +538,7 @@ ring_owner_matmul.defvjp(_rom_fwd, _rom_bwd)
 
 
 def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
-                       allgather=None):
+                       allgather=None, storage_dtype: str = "fp32"):
     """Per-shard aggregation plans, stacked to one static program.  Under
     multihost, ``allgather`` raises the pad floors to the global chunk-count
     maxima so every process compiles the same program."""
@@ -537,12 +548,15 @@ def _build_shard_plans(backend: str, srcs, dsts, S: int, table_rows: int,
         # hw_revalidate; default remains choose_geometry's pick).  The
         # fused single-grid path is stripped at stacking time
         # (pad_binned_plans) — sharded plans take the flat two-pass scan.
+        # Under bf16 storage the forced flat preset rides the 16-row
+        # bf16-unit variant so the staging buffers halve with the wire.
         geom = None
         if os.environ.get("ROC_BINNED_FLAT") == "1":
-            from roc_tpu.ops.pallas.binned import GEOM_FLAT
-            geom = GEOM_FLAT
+            from roc_tpu.ops.pallas.binned import GEOM_FLAT, GEOM_FLAT_BF16
+            geom = GEOM_FLAT_BF16 if storage_dtype == "bf16" else GEOM_FLAT
         plan_list = [ops.build_binned_plans(srcs[i], dsts[i], S, table_rows,
-                                            geom=geom)
+                                            geom=geom,
+                                            storage_dtype=storage_dtype)
                      for i in range(len(srcs))]
         f = _allgather_floors(
             [[p.fwd.p1_blk.shape[1] for p in plan_list],
@@ -566,7 +580,8 @@ from roc_tpu.ops.edge import _Z_GUARD  # noqa: E402  (guard rationale there)
 
 
 def _build_shard_plans_split(backend: str, srcs, dsts, S: int,
-                             halo_rows: int, allgather=None):
+                             halo_rows: int, allgather=None,
+                             storage_dtype: str = "fp32"):
     """(plans_local, plans_remote) for the halo-overlap aggregation.
 
     Each shard's edge list is cut by source residence: table-local ids
@@ -590,16 +605,18 @@ def _build_shard_plans_split(backend: str, srcs, dsts, S: int,
         loc_d.append(di[m].astype(np.int32))
         rem_s.append((si[~m] - S).astype(np.int32))
         rem_d.append(di[~m].astype(np.int32))
-    return (_build_shard_plans(backend, loc_s, loc_d, S, S, allgather),
+    return (_build_shard_plans(backend, loc_s, loc_d, S, S, allgather,
+                               storage_dtype=storage_dtype),
             _build_shard_plans(backend, rem_s, rem_d, S, halo_rows,
-                               allgather))
+                               allgather, storage_dtype=storage_dtype))
 
 
 def shard_graph(part: Partition, halo: Optional[HaloMaps],
                 backend: str = "xla",
                 precision: str = "exact",
                 gat_backend: str = "xla",
-                halo_overlap: bool = False) -> ShardedGraphData:
+                halo_overlap: bool = False,
+                xch: tuple = ("fp32", "nearest", "plain")) -> ShardedGraphData:
     if halo is not None:
         src = halo.edge_src_local
     else:
@@ -607,13 +624,15 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
     P_, S = part.num_parts, part.shard_nodes
     table_rows = S + P_ * halo.K if halo is not None else P_ * S
     plans = plans_local = plans_remote = None
+    sd = "bf16" if xch[0] == "bf16" else "fp32"
     if backend in ("matmul", "binned"):
         if halo is not None and halo_overlap:
             plans_local, plans_remote = _build_shard_plans_split(
-                backend, src, part.edge_dst, S, P_ * halo.K)
+                backend, src, part.edge_dst, S, P_ * halo.K,
+                storage_dtype=sd)
         else:
             plans = _build_shard_plans(backend, src, part.edge_dst, S,
-                                       table_rows)
+                                       table_rows, storage_dtype=sd)
     gat_plans = None
     if gat_backend == "plan":
         from roc_tpu.ops.edge import build_gat_plans, pad_gat_plans
@@ -631,20 +650,93 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         plans_remote=plans_remote,
         backend=backend,
         precision=precision,
+        xch_dtype=xch[0], xch_round=xch[1], xch_comp=xch[2],
     )
+
+
+# -- bf16 wire codec for feature exchanges ----------------------------------
+# Every vertex-mode collective that moves FEATURES over ICI (halo
+# all_to_all, allgather table, ring ppermute hops — and their overcommit
+# variants) funnels through this encode/decode pair.  xch_dtype="bf16"
+# halves the bytes per hop; the decode happens at the aggregation
+# boundary, so all accumulation stays fp32.  Gradient collectives (psum)
+# and the edge-mode psum_scatter reductions stay fp32: those accumulate
+# IN the collective, where a bf16 wire would round partial sums, not
+# inputs.
+
+_SR_SEED = 0x0b16  # fixed fold-in base: SR pattern is deterministic per
+#                    trace (reproducible runs), decorrelated across shards
+
+
+@jax.custom_vjp
+def _sr_bf16(x):
+    """Stochastically round fp32 -> bf16: add 16 random low bits to the
+    fp32 significand and truncate — unbiased (E[sr(x)] = x), so rounding
+    error accumulates as noise rather than drift over deep unrolls.
+    Straight-through gradient (the rounding is zero-mean; its derivative
+    is 1 almost everywhere)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(_SR_SEED),
+                             jax.lax.axis_index(PARTS_AXIS))
+    u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    r = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
+    u = (u + r) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(u, jnp.float32).astype(jnp.bfloat16)
+
+
+def _sr_fwd(x):
+    return _sr_bf16(x), None
+
+
+def _sr_bwd(_, g):
+    return (g.astype(jnp.float32),)
+
+
+_sr_bf16.defvjp(_sr_fwd, _sr_bwd)
+
+
+def _wire_down(x, gd_block):
+    """Encode features for an ICI exchange per the graph's static wire
+    metadata.  bf16 ("nearest" or "stochastic" rounding) halves the bytes;
+    "compensated" sends a (hi, lo) bf16 pair concatenated on the feature
+    axis — same bytes as fp32, the parity control that exercises the bf16
+    pipeline without its rounding.  fp32 (default), or an already-bf16
+    compute dtype, is the identity."""
+    if gd_block.xch_dtype != "bf16" or x.dtype != jnp.float32:
+        return x
+    if gd_block.xch_comp == "compensated":
+        hi = x.astype(jnp.bfloat16)
+        lo = (x - hi.astype(x.dtype)).astype(jnp.bfloat16)
+        return jnp.concatenate([hi, lo], axis=-1)
+    if gd_block.xch_round == "stochastic":
+        return _sr_bf16(x)
+    return x.astype(jnp.bfloat16)
+
+
+def _wire_up(y, gd_block, dtype, H: int):
+    """Decode a _wire_down-encoded exchange back to the compute ``dtype``
+    at the aggregation boundary.  ``H`` is the pre-encode feature width —
+    it disambiguates the compensated (2H-wide) pair from a pass-through."""
+    if gd_block.xch_comp == "compensated" and y.shape[-1] == 2 * H:
+        return y[..., :H].astype(dtype) + y[..., H:].astype(dtype)
+    return y.astype(dtype)
 
 
 def _exchange(gd_block, exchange: str, x):
     """Materialize the per-shard source table for a [S, H] local tensor:
     local rows ++ halo rows (one all_to_all) or the all-gathered tensor.
     (Ring mode never builds a table — see _ring_aggregate.)"""
+    H = x.shape[-1]
     if exchange == "halo":
-        send = jnp.take(x, gd_block.send_idx, axis=0)           # [P, K, H]
+        send = _wire_down(jnp.take(x, gd_block.send_idx, axis=0),
+                          gd_block)                             # [P, K, H]
         recv = jax.lax.all_to_all(send, PARTS_AXIS,
                                   split_axis=0, concat_axis=0)
+        halo = _wire_up(recv, gd_block, x.dtype, H)
         return jnp.concatenate(
-            [x, recv.reshape(-1, x.shape[-1])], axis=0)         # [S+P*K, H]
-    return jax.lax.all_gather(x, PARTS_AXIS, tiled=True)        # [P*S, H]
+            [x, halo.reshape(-1, H)], axis=0)                   # [S+P*K, H]
+    table = jax.lax.all_gather(_wire_down(x, gd_block), PARTS_AXIS,
+                               tiled=True)                      # [P*S, H]
+    return _wire_up(table, gd_block, x.dtype, H)
 
 
 def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
@@ -658,11 +750,15 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
     p = jax.lax.axis_index(PARTS_AXIS)
     base = "sum" if aggr in ("sum", "avg") else aggr
     perm = [(i, (i + 1) % P_) for i in range(P_)]
+    H = x.shape[-1]
 
     rp = gd_block.ring_plans
 
     def step(carry, k):
         buf, acc = carry
+        # the carry rotates in wire format (each ppermute hop moves the
+        # encoded bytes); decode at the aggregation boundary
+        xb = _wire_up(buf, gd_block, x.dtype, H)
         owner = jax.lax.rem(p - k + P_, P_)       # whose rows buf holds
         if rp is not None and base == "sum":
             # plan fast path: the owner's group aggregation is one-hot
@@ -672,14 +768,14 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
             bwd = tuple(jnp.take(a, owner, axis=0)
                         for a in (rp.bwd_obi, rp.bwd_edst, rp.bwd_esrc))
             part = ring_owner_matmul(
-                buf, fwd, bwd, S,
+                xb, fwd, bwd, S,
                 ops.matmul_precision(gd_block.precision))
             acc = acc + part
             buf = jax.lax.ppermute(buf, PARTS_AXIS, perm)
             return (buf, acc), None
         es = jnp.take(gd_block.ring_src, owner, axis=0)       # [Eo]
         ed = jnp.take(gd_block.ring_dst, owner, axis=0)       # [Eo], pad=S
-        gathered = jnp.take(buf, es, axis=0)
+        gathered = jnp.take(xb, es, axis=0)
         if base == "sum":
             part = jax.ops.segment_sum(gathered, ed, num_segments=S + 1,
                                        indices_are_sorted=True)[:S]
@@ -700,7 +796,6 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
         buf = jax.lax.ppermute(buf, PARTS_AXIS, perm)
         return (buf, acc), None
 
-    H = x.shape[-1]
     # pcast: the scan carry must share x's device-varying vma annotation
     # under shard_map.  NOT the `+ 0 * x` trick — with a non-finite init
     # (max/min) that creates a gradient edge into x through which a
@@ -708,7 +803,8 @@ def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
     init = jax.lax.pcast(
         jnp.full((S, H), {"sum": 0.0, "max": -jnp.inf, "min": jnp.inf}
                  [base], x.dtype), PARTS_AXIS, to="varying")
-    (_, acc), _ = jax.lax.scan(step, (x, init), jnp.arange(P_))
+    (_, acc), _ = jax.lax.scan(step, (_wire_down(x, gd_block), init),
+                               jnp.arange(P_))
     if aggr == "avg":
         acc = ops.divide_by_degree(acc, gd_block.in_degree)
     if base in ("max", "min"):
@@ -813,10 +909,12 @@ def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
 
     def step(carry, k):
         buf, m, z, u = carry
+        # wire-format carry: decode the visiting shard at the boundary
+        hb = _wire_up(buf, gd_block, h.dtype, F)
         owner = jax.lax.rem(p - k + P_, P_)
         es = jnp.take(gd_block.ring_src, owner, axis=0)   # [Eo]
         ed = jnp.take(gd_block.ring_dst, owner, axis=0)   # [Eo], pad = S
-        as_t = jnp.einsum("nkf,kf->nk", buf, a_src)       # [S, K]
+        as_t = jnp.einsum("nkf,kf->nk", hb, a_src)        # [S, K]
         s = jax.nn.leaky_relu(
             jnp.take(ad_pad, ed, axis=0) + jnp.take(as_t, es, axis=0),
             negative_slope=slope)                          # [Eo, K]
@@ -832,7 +930,7 @@ def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
         e = jnp.exp(s - shift)     # pads: exp(NEG - 0) underflows to 0
         z_step = jax.ops.segment_sum(e, ed, num_segments=S + 1,
                                      indices_are_sorted=True)[:S]
-        g = jnp.take(buf, es, axis=0)                     # [Eo, K, F]
+        g = jnp.take(hb, es, axis=0)                      # [Eo, K, F]
         u_step = jax.ops.segment_sum(g * e[:, :, None], ed,
                                      num_segments=S + 1,
                                      indices_are_sorted=True)[:S]
@@ -853,8 +951,8 @@ def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
     u0 = jax.lax.pcast(jnp.zeros((S, K, F)), PARTS_AXIS, to="varying")
     (_, _, z, u), _ = jax.lax.scan(  # ring-step remat keeps the rotating
         # buffer out of the residual set  # roclint: allow(remat)
-        jax.checkpoint(step, prevent_cse=False), (h, m0, z0, u0),
-        jnp.arange(P_))
+        jax.checkpoint(step, prevent_cse=False),
+        (_wire_down(h, gd_block), m0, z0, u0), jnp.arange(P_))
     # _Z_GUARD (ops/edge.py): big enough to survive BOTH the XLA
     # subnormal flush AND the autodiff division transpose (0/0 on
     # edgeless rows); live rows have z >= 1 by the max shift
@@ -933,12 +1031,14 @@ def _shard_gctx(gd_block, shard_nodes: int, exchange: str) -> GraphCtx:
             # remote-source contributions from the received halo rows —
             # the explicit form of the reference's Legion pipelining
             # (scattergather.cc:49-81 async IndexLaunchers).
-            send = jnp.take(x, gd_block.send_idx, axis=0)        # [P, K, H]
+            send = _wire_down(jnp.take(x, gd_block.send_idx, axis=0),
+                              gd_block)                          # [P, K, H]
             recv = jax.lax.all_to_all(send, PARTS_AXIS,
                                       split_axis=0, concat_axis=0)
             out = _plan_sum(x, gd_block.plans_local, gd_block.backend,
                             gd_block.precision, shard_nodes, interp)
-            out = out + _plan_sum(recv.reshape(-1, x.shape[-1]),
+            halo = _wire_up(recv, gd_block, x.dtype, x.shape[-1])
+            out = out + _plan_sum(halo.reshape(-1, x.shape[-1]),
                                   gd_block.plans_remote, gd_block.backend,
                                   gd_block.precision, shard_nodes, interp)
             if aggr == "avg":
@@ -1022,8 +1122,9 @@ def _overcommit_tables(gd_block, k: int, S: int, exchange: str, x):
     parts (padded-global ids index [P*S] in device-major == part order)."""
     H = x.shape[-1]
     if exchange != "halo":
-        table = jax.lax.all_gather(x, PARTS_AXIS, tiled=True)   # [P*S, H]
-        return [table] * k
+        table = jax.lax.all_gather(_wire_down(x, gd_block), PARTS_AXIS,
+                                   tiled=True)                  # [P*S, H]
+        return [_wire_up(table, gd_block, x.dtype, H)] * k
     sidx = gd_block.send_idx                 # [k_i, P, K] (i = sender)
     k_, P_, K = sidx.shape
     D = P_ // k
@@ -1031,8 +1132,10 @@ def _overcommit_tables(gd_block, k: int, S: int, exchange: str, x):
     # offsets: send_idx values are local to sender part i
     idx = sidx.reshape(k, D, k, K).transpose(1, 0, 2, 3) \
         + (jnp.arange(k, dtype=sidx.dtype) * S)[None, :, None, None]
-    send = jnp.take(x, idx.reshape(D, k * k * K), axis=0)
+    send = _wire_down(jnp.take(x, idx.reshape(D, k * k * K), axis=0),
+                      gd_block)
     recv = jax.lax.all_to_all(send, PARTS_AXIS, split_axis=0, concat_axis=0)
+    recv = _wire_up(recv, gd_block, x.dtype, H)
     recv = recv.reshape(D, k, k, K, H)       # [from-dev, from-part, j, K, H]
     tables = []
     for j in range(k):
@@ -1124,6 +1227,16 @@ class SpmdTrainer(BaseTrainer):
         return bool(self.config.halo_overlap) and self.k == 1 \
             and self._exchange_mode == "halo"
 
+    def _xch_meta(self) -> tuple:
+        """(xch_dtype, xch_round, xch_comp) wire metadata for the feature
+        exchanges, from the config's bf16-storage knobs.  Edge-shard mode
+        is excluded: its psum_scatter reductions accumulate in-network,
+        where a bf16 wire would round partial sums rather than inputs."""
+        cfg = self.config
+        if not cfg.bf16_storage or self._use_edge_shard:
+            return ("fp32", "nearest", "plain")
+        return ("bf16", cfg.bf16_rounding, cfg.bf16_exchange)
+
     def _build_graph_full(self, backend: str,
                           gat_backend: str = "xla") -> ShardedGraphData:
         """Single-host path: whole graph in memory, all P parts built."""
@@ -1170,6 +1283,7 @@ class SpmdTrainer(BaseTrainer):
             if backend == "matmul":
                 rp = build_ring_plans(rm, self.part.shard_nodes)
                 ring_plans = jax.tree.map(jnp.asarray, rp)
+            xd, xr, xc = self._xch_meta()
             return ShardedGraphData(
                 edge_src=jnp.asarray(self.part.edge_src, jnp.int32),
                 edge_dst=jnp.asarray(self.part.edge_dst, jnp.int32),
@@ -1178,7 +1292,8 @@ class SpmdTrainer(BaseTrainer):
                 ring_src=jnp.asarray(rm.ring_src),
                 ring_dst=jnp.asarray(rm.ring_dst),
                 plans=None, ring_plans=ring_plans, backend=backend,
-                mode="ring", precision=cfg.aggregate_precision)
+                mode="ring", precision=cfg.aggregate_precision,
+                xch_dtype=xd, xch_round=xr, xch_comp=xc)
         self.halo = build_halo_maps(self.part) \
             if self._exchange_mode == "halo" else None
         if backend == "matmul" and cfg.aggregate_backend == "auto":
@@ -1197,7 +1312,8 @@ class SpmdTrainer(BaseTrainer):
                 backend = "binned"
         return shard_graph(self.part, self.halo, backend,
                            cfg.aggregate_precision, gat_backend=gat_backend,
-                           halo_overlap=self._halo_overlap())
+                           halo_overlap=self._halo_overlap(),
+                           xch=self._xch_meta())
 
     def _build_graph_perhost(self, backend: str,
                              gat_backend: str = "xla") -> ShardedGraphData:
@@ -1288,6 +1404,7 @@ class SpmdTrainer(BaseTrainer):
             if backend == "matmul":
                 rp = build_ring_plans(rm, S, allgather=ag)
                 ring_plans = jax.tree.map(jnp.asarray, rp)
+            xd, xr, xc = self._xch_meta()
             return ShardedGraphData(
                 edge_src=jnp.asarray(local.edge_src, jnp.int32),
                 edge_dst=jnp.asarray(local.edge_dst, jnp.int32),
@@ -1296,7 +1413,8 @@ class SpmdTrainer(BaseTrainer):
                 ring_src=jnp.asarray(rm.ring_src),
                 ring_dst=jnp.asarray(rm.ring_dst),
                 plans=None, ring_plans=ring_plans, backend=backend,
-                mode="ring", precision=cfg.aggregate_precision)
+                mode="ring", precision=cfg.aggregate_precision,
+                xch_dtype=xd, xch_round=xr, xch_comp=xc)
         lhalo = shard_load.build_halo_local(meta, local, ag) \
             if self._exchange_mode == "halo" else None
         self.halo = lhalo
@@ -1304,14 +1422,16 @@ class SpmdTrainer(BaseTrainer):
         src = lhalo.edge_src_local if lhalo is not None else local.edge_src
         table_rows = S + P_ * lhalo.K if lhalo is not None else P_ * S
         plans = plans_local = plans_remote = None
+        sd = "bf16" if self._xch_meta()[0] == "bf16" else "fp32"
         if backend in ("matmul", "binned"):
             if lhalo is not None and self._halo_overlap():
                 plans_local, plans_remote = _build_shard_plans_split(
                     backend, src, local.edge_dst, S, P_ * lhalo.K,
-                    allgather=ag)
+                    allgather=ag, storage_dtype=sd)
             else:
                 plans = _build_shard_plans(backend, src, local.edge_dst, S,
-                                           table_rows, allgather=ag)
+                                           table_rows, allgather=ag,
+                                           storage_dtype=sd)
         gat_plans = None
         if gat_backend == "plan":
             from roc_tpu.ops.edge import build_gat_plans, pad_gat_plans
@@ -1322,6 +1442,7 @@ class SpmdTrainer(BaseTrainer):
                 [[p.dst_obi.shape[0] for p in local_plans],
                  [p.src_obi.shape[0] for p in local_plans]], ag)
             gat_plans = pad_gat_plans(local_plans, min_d=f[0], min_s=f[1])
+        xd, xr, xc = self._xch_meta()
         return ShardedGraphData(
             edge_src=jnp.asarray(src, jnp.int32),
             edge_dst=jnp.asarray(local.edge_dst, jnp.int32),
@@ -1332,7 +1453,8 @@ class SpmdTrainer(BaseTrainer):
             plans_local=plans_local,
             plans_remote=plans_remote,
             backend=backend,
-            precision=cfg.aggregate_precision)
+            precision=cfg.aggregate_precision,
+            xch_dtype=xd, xch_round=xr, xch_comp=xc)
 
     def _place_parts(self, gd: ShardedGraphData,
                      spec: NamedSharding) -> ShardedGraphData:

@@ -77,6 +77,20 @@ class Config:
     checkpoint_every: int = 0     # 0 = disabled
     resume: bool = False
     use_bf16: bool = False        # opt-in activation bf16 (SURVEY §7 non-goal note)
+    bf16_storage: bool = False    # bf16 STORAGE / fp32 accumulation on the
+                                  # memory-bound hot paths: flat-schedule
+                                  # staging moves bf16 (16-row units) and
+                                  # ICI feature exchanges (halo/allgather/
+                                  # ring) go over the wire as bf16, upcast
+                                  # at the aggregation boundary.  Compute
+                                  # and activations stay fp32 (unlike
+                                  # -bf16, which casts activations).
+    bf16_rounding: str = "nearest"  # bf16 downcast mode for the exchange
+                                  # wire: nearest | stochastic (unbiased
+                                  # SR for parity-sensitive runs)
+    bf16_exchange: str = "plain"  # plain: one bf16 term (half the bytes) |
+                                  # compensated: (hi, lo) bf16 pair — fp32
+                                  # bytes, parity control for the pipeline
     lazy_load: bool = False       # memmap features / defer one-hot labels
                                   # (sharded host loading for huge graphs)
     halo: bool = True             # v1 halo exchange vs v0 all_gather
@@ -159,6 +173,26 @@ class Config:
         if env.get("ROC_MEM_BUDGET"):
             self.mem_budget = env["ROC_MEM_BUDGET"]
         parse_size(self.mem_budget)  # validate eagerly (SystemExit if bad)
+        # ROC_BF16_* mirror -bf16-storage/-bf16-rounding/-bf16-exchange for
+        # driverless entry points (bench.py, hw_revalidate A/B loops).
+        if env.get("ROC_BF16_STORAGE"):
+            self.bf16_storage = env["ROC_BF16_STORAGE"] == "1"
+        if env.get("ROC_BF16_ROUNDING"):
+            self.bf16_rounding = env["ROC_BF16_ROUNDING"]
+        if env.get("ROC_BF16_EXCHANGE"):
+            self.bf16_exchange = env["ROC_BF16_EXCHANGE"]
+        if self.bf16_rounding not in ("nearest", "stochastic"):
+            raise SystemExit(f"bad bf16_rounding {self.bf16_rounding!r} "
+                             "(nearest|stochastic)")
+        if self.bf16_exchange not in ("plain", "compensated"):
+            raise SystemExit(f"bad bf16_exchange {self.bf16_exchange!r} "
+                             "(plain|compensated)")
+        if self.bf16_storage and self.aggregate_precision == "exact":
+            # the binned flat bf16 unit and the bf16 wire both round where
+            # "exact" promises fp32 end to end — refuse the contradiction
+            raise SystemExit("-bf16-storage is incompatible with "
+                             "-aggr-precision exact (bf16 storage rounds "
+                             "features; exact promises fp32 end to end)")
 
     def mem_budget_bytes(self) -> int:
         """-mem-budget in bytes (0 = unset; driver falls back to the
@@ -201,6 +235,12 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-ckpt-every", dest="checkpoint_every", type=int, default=0)
     p.add_argument("-resume", action="store_true")
     p.add_argument("-bf16", dest="use_bf16", action="store_true")
+    p.add_argument("-bf16-storage", dest="bf16_storage",
+                   action="store_true")
+    p.add_argument("-bf16-rounding", dest="bf16_rounding",
+                   default="nearest", choices=["nearest", "stochastic"])
+    p.add_argument("-bf16-exchange", dest="bf16_exchange",
+                   default="plain", choices=["plain", "compensated"])
     p.add_argument("-lazy", dest="lazy_load", action="store_true")
     p.add_argument("-no-halo", dest="halo", action="store_false")
     p.add_argument("-no-halo-overlap", dest="halo_overlap",

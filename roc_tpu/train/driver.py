@@ -78,7 +78,8 @@ AUTO_BINNED = True
 
 
 def resolve_backend_geom(backend: str, num_edges: int, num_rows: int = 0,
-                         table_rows: int = 0, edge_src=None, edge_dst=None):
+                         table_rows: int = 0, edge_src=None, edge_dst=None,
+                         storage_dtype: str = "fp32"):
     """Resolve the aggregation backend; returns (backend, geometry).
 
     With edge arrays provided, the binned-vs-matmul call uses ACTUAL cell
@@ -96,7 +97,8 @@ def resolve_backend_geom(backend: str, num_edges: int, num_rows: int = 0,
         if AUTO_BINNED and num_rows:
             if edge_src is not None:
                 g, _ = choose_geometry(edge_src, edge_dst, num_rows,
-                                       table_rows)
+                                       table_rows,
+                                       storage_dtype=storage_dtype)
                 if g is not None:
                     return "binned", g
             elif binned_viable(num_rows, table_rows, num_edges):
@@ -130,10 +132,11 @@ def resolve_gat_backend(backend: str, num_edges: int) -> str:
 
 def dense_graph_data(graph, backend: str = "xla",
                      precision: str = "exact",
-                     gat_backend: str = "xla") -> DenseGraphData:
+                     gat_backend: str = "xla",
+                     storage_dtype: str = "fp32") -> DenseGraphData:
     backend, geom = resolve_backend_geom(
         backend, graph.num_edges, graph.num_nodes, graph.num_nodes,
-        graph.col_idx, graph.dst_idx)
+        graph.col_idx, graph.dst_idx, storage_dtype=storage_dtype)
     plans = None
     if backend == "matmul":
         plans = ops.build_aggregate_plans(
@@ -143,7 +146,7 @@ def dense_graph_data(graph, backend: str = "xla",
         # bwd (the transposed direction) still chooses its own
         plans = ops.build_binned_plans(
             graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes,
-            geom=(geom or "auto", "auto"))
+            geom=(geom or "auto", "auto"), storage_dtype=storage_dtype)
     gat_plans = None
     if gat_backend == "plan":
         from roc_tpu.ops.edge import build_gat_plans
@@ -233,7 +236,13 @@ class BaseTrainer:
         if config.balance_every:
             if self._balance_supported():
                 from roc_tpu.balance.manager import BalanceManager
-                self.balancer = BalanceManager.from_config(config)
+                # Warm-start prior priced at the run's actual halo bytes:
+                # the dataset's feature width and the wire itemsize (bf16
+                # storage and bf16 features both exchange 2-byte rows).
+                wire2 = config.bf16_storage or config.use_bf16
+                self.balancer = BalanceManager.from_config(
+                    config, halo_width=self.dataset.in_dim,
+                    halo_itemsize=2 if wire2 else 4)
             elif config.verbose:
                 print("# -balance-every: online balancing needs the SPMD "
                       "vertex-sharded path (parts > 1, k = 1, no "
@@ -464,9 +473,10 @@ class Trainer(BaseTrainer):
     def _setup(self):
         ds, model = self.dataset, self.model
         backend = self._effective_backend()
-        self.gdata = dense_graph_data(ds.graph, backend,
-                                      self.config.aggregate_precision,
-                                      gat_backend=self._gat_backend())
+        self.gdata = dense_graph_data(
+            ds.graph, backend, self.config.aggregate_precision,
+            gat_backend=self._gat_backend(),
+            storage_dtype="bf16" if self.config.bf16_storage else "fp32")
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.onehot_labels(), jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)

@@ -24,6 +24,7 @@ from roc_tpu.ops.pallas import binned as B
 GF = B.Geometry(sb=256, ch=512, slot=128, rb=256, ch2=512, grt=1 << 14,
                 flat=1)
 GF2 = GF._replace(flat=0)           # the slot-padded control at same shape
+GFB = GF._replace(unit=16)          # bf16-staging variant (16-row units)
 
 CASES = [
     # (num_rows, table_rows, num_edges, hidden)
@@ -73,6 +74,55 @@ def test_flat_bit_equals_twopass_and_oracle(n, t, e, h, fuse, monkeypatch):
     out_e = np.asarray(B.run_binned(jnp.asarray(x), pf, interpret=True,
                                     precision="exact"))
     np.testing.assert_array_equal(out_e, _oracle_int(x, src, dst, n))
+
+
+@pytest.mark.parametrize("fuse", [False, True])
+def test_flat_bf16_unit_bit_equals_oracle(fuse, monkeypatch):
+    """unit=16 flat plans stage in bf16 (16-row Mosaic tiles, half the
+    staging-DMA bytes): primary and secondary chunk rows are disjoint, so
+    every staged row is rounded to bf16 exactly once, and small-integer
+    data survives that rounding — both run paths must stay BIT-identical
+    to the add.at oracle, exactly like the fp32-staged flat plan."""
+    if not fuse:
+        monkeypatch.setenv("ROC_BINNED_NO_FUSE", "1")
+    for n, t, e, h in [(700, 700, 5000, 64), (GF.sb + 1, GF.sb + 1, 300, 16),
+                       (700, 700, 5000, 41)]:
+        src, dst, x = _int_graph(n, t, e, h, 42)
+        pb = B.build_binned_plan(src, dst, n, t, geom=GFB)
+        assert pb.geom.unit == 16
+        assert B.staging_dtype(pb.geom, False) == jnp.bfloat16
+        if fuse:
+            assert pb.f_meta is not None
+        out = np.asarray(B.run_binned(jnp.asarray(x), pb, interpret=True))
+        np.testing.assert_array_equal(out, _oracle_int(x, src, dst, n),
+                                      err_msg=f"n={n} t={t} e={e} h={h}")
+
+
+def test_flat_bf16_unit_rejects_exact():
+    """precision='exact' contracts fp32 staging; a unit=16 plan can't
+    provide it, and silently widening would desync gbuf/DMA dtypes — so
+    run_binned must refuse."""
+    src = np.array([0, 1], np.int64)
+    dst = np.array([1, 0], np.int64)
+    plan = B.build_binned_plan(src, dst, 32, 32, geom=GFB)
+    with pytest.raises(ValueError, match="exact"):
+        B.run_binned(jnp.ones((32, 16), jnp.float32), plan, interpret=True,
+                     precision="exact")
+
+
+def test_flat_bf16_staging_bytes_pin():
+    """bf16-storage acceptance pin (same reddit_scaled shape as the
+    kernel-budget gate): GEOM_FLAT_BF16 must move <= 0.6x GEOM_FLAT's
+    predicted staging-DMA bytes.  Not a clean 0.5: the 16-row unit pads
+    every touched cell to twice the fp32 unit's rows (~0.50 measured on
+    this shape)."""
+    n, e = 32768, 4_194_304
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, n, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    b32 = B.staging_bytes_for(src, dst, B.GEOM_FLAT)
+    b16 = B.staging_bytes_for(src, dst, B.GEOM_FLAT_BF16)
+    assert b16 <= 0.6 * b32, (b16, b32, b16 / b32)
 
 
 def test_flat_bwd_bit_equals_twopass_and_oracle():
@@ -199,7 +249,7 @@ def test_native_flat_plan_equals_numpy():
     if not native.available():
         pytest.skip("native library unavailable")
     rng = np.random.default_rng(13)
-    for geom in (GF, B.GEOM_FLAT_SPARSE._replace(grt=1 << 14)):
+    for geom in (GF, B.GEOM_FLAT_SPARSE._replace(grt=1 << 14), GFB):
         for (n, t, e) in [(700, 700, 5000), (3 * geom.rb, 1000, 3000),
                           (5000, 4000, 120000), (100, 100, 0)]:
             src = rng.integers(0, t, e).astype(np.int64)
@@ -259,6 +309,12 @@ def test_flat_plan_cache_roundtrip(tmp_path, monkeypatch):
     p3 = B.build_binned_plan(src, dst, n, n, geom=GF2)
     assert p3.geom == GF2
     assert len([f for f in tmp_path.iterdir() if f.suffix == ".npz"]) == 2
+    # ... and so is the staging unit: unit=16 (bf16) at the same windows
+    # must MISS too — a cached fp32-unit plan served to a bf16 run would
+    # stage through the wrong dtype
+    p4 = B.build_binned_plan(src, dst, n, n, geom=GFB)
+    assert p4.geom == GFB
+    assert len([f for f in tmp_path.iterdir() if f.suffix == ".npz"]) == 3
 
 
 def test_run_binned_warns_once_outside_jit():

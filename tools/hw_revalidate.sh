@@ -110,6 +110,21 @@ ROC_BENCH_MEM=1 ROC_MEM_PLAN=auto ROC_MEM_BUDGET=4g ROC_BENCH_EPOCHS=5 \
 timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 10 -parts 4 -mem-plan auto -mem-budget 2g -v 2>&1 \
     | tail -3 | tee -a "$LOG"
+
+note "3f. bf16-storage A/B at the canonical Reddit GCN shape: paired legs"
+note "    (fp32 storage, then ROC_BF16_STORAGE=1) — compare epoch time"
+note "    (expect the bf16 leg faster where the run is staging/halo"
+note "    byte-bound; artifact 'dtype' field distinguishes the pair) and"
+note "    final loss (parity gate: |bf16 - fp32| within 1e-2)"
+ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
+ROC_BF16_STORAGE=1 ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
+# sharded loss A/B (halo wire rides bf16; -v prints per-epoch loss)
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -v 2>&1 | tail -2 | tee -a "$LOG"
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -bf16-storage -v 2>&1 | tail -2 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 4 ]; then
