@@ -590,6 +590,23 @@ def run():
                     plan.predicted_step_s / remat.predicted_step_s - 1, 4),
             }
         result["memory"] = mem
+    reg = getattr(trainer, "_metrics", None)
+    if reg is not None:
+        # -obs / ROC_OBS=1 run: stamp the unified metrics block (the
+        # canonical-claim conditions below are unchanged — obs observes,
+        # it never annotates the metric itself)
+        from roc_tpu import obs
+        wd = getattr(trainer, "watchdog", None)
+        result["metrics"] = {
+            "grad_norms": [round(v, 6)
+                           for v in reg.series("metrics", "grad_norm")],
+            "wire_bytes_per_step": (
+                int(reg.latest["metrics_wire_bytes"])
+                if "metrics_wire_bytes" in reg.latest else None),
+            "watchdog_verdict": wd.verdict() if wd is not None else "off",
+            "watchdog_alerts": list(wd.alerts) if wd is not None else [],
+            "span_types": sorted(obs.get_tracer().span_types()),
+        }
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0

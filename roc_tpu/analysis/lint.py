@@ -40,6 +40,14 @@ preceding line):
     ``-mem-plan``); policy.py is the one sanctioned call site.  Scan-body
     remat (where the plan abstraction doesn't apply) carries explicit
     waivers.
+``raw-timing``
+    A ``t = time.perf_counter()`` / ``perf_counter_ns()`` assignment
+    paired with a later ``... - t`` use — a hand-rolled timing window —
+    in any ``.py`` file outside ``roc_tpu/obs/``.  The obs span tracer
+    is the one sanctioned wall-clock site (``with obs.span("x") as sp``
+    then ``sp.dur_s``): spans land in the exported trace, nest, and are
+    disabled in one place.  Only real file paths are checked (inline
+    ``lint_source`` fixtures are exempt).
 
 A *jitted context* is a function that is (a) decorated with ``jax.jit``
 / ``jax.shard_map`` / ``jax.custom_vjp`` (directly or via ``partial``),
@@ -91,6 +99,10 @@ _REMAT_CALLS = {
 # The one module allowed to call them: the memory planner's policy
 # compiler (plans are budgeted there; see roc_tpu/memory).
 _REMAT_EXEMPT_SUFFIX = os.path.join("roc_tpu", "memory", "policy.py")
+# The one package allowed raw monotonic clocks: the span tracer itself
+# (everything else times through `obs.span` so measurements reach the
+# exported trace).
+_RAW_TIMING_EXEMPT_DIR = os.path.join("roc_tpu", "obs") + os.sep
 
 
 @dataclasses.dataclass(frozen=True)
@@ -206,6 +218,7 @@ class _FileLint:
         roots = self._jitted_roots()
         self._rule_jit_scope(roots)
         self._rule_timed_windows()
+        self._rule_raw_timing()
         self._rule_unkeyed_rand()
         self._rule_mutable_default()
         self._rule_closure_capture()
@@ -302,6 +315,56 @@ class _FileLint:
                             f"{name} inside the timed window of "
                             f"{t!r} ({lo}..{hi}) — timing a host sync; "
                             f"move it out or waive with a justification")
+
+    @classmethod
+    def _scope_walk(cls, scope):
+        """Pre-order walk that does not descend into nested functions, so
+        each timing window binds within one scope."""
+        for child in ast.iter_child_nodes(scope):
+            yield child
+            if not isinstance(child, _FUNC_NODES):
+                yield from cls._scope_walk(child)
+
+    @staticmethod
+    def _is_perf_clock(expr) -> bool:
+        for c in ast.walk(expr):
+            if isinstance(c, ast.Call):
+                head = _dotted(c.func) or ""
+                if head.endswith("perf_counter") or \
+                        head.endswith("perf_counter_ns"):
+                    return True
+        return False
+
+    def _rule_raw_timing(self):
+        """Hand-rolled perf_counter windows outside roc_tpu/obs/."""
+        if not self.path.endswith(".py"):
+            return  # inline lint_source fixtures ("<string>") are exempt
+        if _RAW_TIMING_EXEMPT_DIR in self.path.replace("/", os.sep):
+            return
+        scopes = [self.tree] + [n for n in ast.walk(self.tree)
+                                if isinstance(n, _FUNC_NODES)]
+        for scope in scopes:
+            starts: Dict[str, ast.AST] = {}
+            flagged: Set[str] = set()
+            for node in self._scope_walk(scope):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        self._is_perf_clock(node.value):
+                    starts.setdefault(node.targets[0].id, node)
+                elif isinstance(node, ast.BinOp) and \
+                        isinstance(node.op, ast.Sub) and \
+                        isinstance(node.right, ast.Name) and \
+                        node.right.id in starts and \
+                        node.right.id not in flagged and \
+                        node.lineno > starts[node.right.id].lineno:
+                    t = node.right.id
+                    flagged.add(t)
+                    self._flag(
+                        starts[t], "raw-timing",
+                        f"raw perf_counter timing window for {t!r}; time "
+                        f"through obs.span (roc_tpu/obs is the sanctioned "
+                        f"clock site) so the measurement reaches the trace")
 
     def _rule_unkeyed_rand(self):
         for node in ast.walk(self.tree):

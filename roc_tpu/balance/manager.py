@@ -23,11 +23,11 @@ trajectory bit-for-bit unchanged.
 from __future__ import annotations
 
 import functools
-import time
 from typing import List, Optional
 
 import numpy as np
 
+from roc_tpu import obs
 from roc_tpu.balance import search
 from roc_tpu.balance.cost_model import OnlineCostModel
 from roc_tpu.balance.telemetry import ShardSample, TelemetryBuffer
@@ -82,11 +82,12 @@ def probe_part_times(part: Partition, width: int = _PROBE_WIDTH
         fn(table, src, dst).block_until_ready()  # compile + warm
         best = np.inf
         for _ in range(_PROBE_TRIES):
-            t0 = time.perf_counter()
-            # the probe times exactly this sync: device latency of one
-            # part's aggregation, min-of-tries against timer noise
-            fn(table, src, dst).block_until_ready()  # roclint: allow(host-sync)
-            best = min(best, time.perf_counter() - t0)
+            # the probe span times exactly this sync: device latency of
+            # one part's aggregation, min-of-tries against timer noise
+            # (obs.span is the sanctioned clock — raw-timing lint rule)
+            with obs.span("probe", part=p, reps=reps) as sp:
+                fn(table, src, dst).block_until_ready()
+            best = min(best, sp.dur_s)
         out.append(best / reps)
     return out
 
@@ -112,12 +113,17 @@ class BalanceManager:
         self.reshard_cost_s: Optional[float] = None
         self.rounds = 0
         self.events: List[dict] = []
+        # Optional obs.PerfWatchdog: when the driver runs with -obs it
+        # points this at its watchdog so probe-time stragglers land in the
+        # same alert stream as slow epochs.
+        self.watchdog = None
 
     @classmethod
-    def from_config(cls, cfg, halo_width: int = 0,
-                    halo_itemsize: int = 0) -> "BalanceManager":
+    def from_config(cls, cfg, halo_width: int = 0, halo_itemsize: int = 0,
+                    telemetry: Optional[TelemetryBuffer] = None
+                    ) -> "BalanceManager":
         return cls(min_gain=cfg.balance_min_gain,
-                   trace_path=cfg.balance_trace,
+                   trace_path=cfg.balance_trace, telemetry=telemetry,
                    halo_width=halo_width, halo_itemsize=halo_itemsize)
 
     # -- the four stages --------------------------------------------------
@@ -163,7 +169,13 @@ class BalanceManager:
             return None
         graph = trainer.dataset.graph
         self.rounds += 1
-        self.collect(part, graph, epoch)
+        samples = self.collect(part, graph, epoch)
+        if self.watchdog is not None:
+            # same probe times the cost model fits; a straggler alert
+            # lands in the JSONL next to the round that should fix it
+            for alert in self.watchdog.observe_shards(
+                    epoch, [s.time_s for s in samples]):
+                self.telemetry.record_event("watchdog", **alert)
         r2 = self.fit()
         bounds, t_new, t_cur = self.propose(part, graph)
         ev = self._decide(trainer, part, bounds, t_new, t_cur, epoch,

@@ -78,7 +78,9 @@ class TelemetryBuffer:
             rec["peak_hbm_source"] = peak_hbm_source
         self._emit(rec)
 
-    def record_event(self, kind: str, **fields) -> None:
+    def record_event(self, kind: str, /, **fields) -> None:
+        # positional-only: watchdog alerts legitimately carry a "kind"
+        # FIELD (slow-epoch/straggler) next to the record's type
         self._emit({"type": kind, **fields})
 
     def _emit(self, obj: dict) -> None:

@@ -1,0 +1,44 @@
+"""CLI: `python -m roc_tpu.obs report|selftest`.
+
+report   — text summary of a -obs run's trace.json + metrics.jsonl
+selftest — the preflight obs gate (tracer schema, watchdog fire/quiet,
+           span overhead bound); exit 0 green, 1 red
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="roc_tpu.obs", description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="summarize a -obs run's artifacts")
+    rp.add_argument("-dir", dest="obs_dir", default="roc_obs",
+                    help="obs output dir (default: roc_obs)")
+    rp.add_argument("-trace", default="", help="trace.json path override")
+    rp.add_argument("-metrics", default="", help="metrics.jsonl override")
+    sub.add_parser("selftest", help="obs gate: schema + watchdog + overhead")
+    ns = p.parse_args(argv)
+
+    if ns.cmd == "selftest":
+        from roc_tpu.obs.report import selftest
+        return selftest()
+
+    from roc_tpu.obs.report import report
+    trace = ns.trace or os.path.join(ns.obs_dir, "trace.json")
+    metrics = ns.metrics or os.path.join(ns.obs_dir, "metrics.jsonl")
+    print(report(trace_path=trace if os.path.exists(trace) else "",
+                 metrics_path=metrics if os.path.exists(metrics) else ""))
+    if not (os.path.exists(trace) or os.path.exists(metrics)):
+        print(f"# no artifacts under {ns.obs_dir!r} "
+              "(run with -obs / ROC_OBS=1 first)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

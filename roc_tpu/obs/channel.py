@@ -1,0 +1,76 @@
+"""In-graph metrics channel: scalars that ride the jitted step's outputs.
+
+The contract (DESIGN.md §Observability): metrics computed *inside* jit may
+add **zero host syncs** (roclint's host-sync rule stays clean — nothing
+here calls device_get/asarray under trace), **zero collectives** (the
+static budget audit diffs collective op counts; a metrics build must not
+move them), and **zero retraces** (the obs flag keys the step cache once;
+epochs 2..N still hit).  That pins the design:
+
+  * grad/param norms are computed on values that are ALREADY replicated —
+    grads after the step's existing psum, params after the update — so a
+    replicated `P()` out-spec needs no new collective;
+  * per-exchange wire bytes are a *trace-time Python constant* (the
+    exchange geometry — send rows, feature width, wire dtype — is static
+    metadata), folded in as a literal;
+  * per-shard edge counts reduce only the shard's own block
+    (`P(PARTS_AXIS)` out-spec: one scalar per device, no exchange).
+
+The host fetches the whole metrics pytree once per epoch with the same
+`jax.device_get` cadence as eval — after the epoch's timed window, so the
+fetch never pollutes `epoch_times`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """L2 norm over every leaf of a pytree (fp32 accumulation)."""
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.float32(0.0)
+    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(total)
+
+
+def wire_itemsize(xch_dtype: str, xch_comp: str = "plain") -> int:
+    """Effective bytes per exchanged fp32-equivalent element: bf16 plain
+    halves the wire, compensated (hi, lo bf16 pair) is fp32-width again."""
+    item = 2 if xch_dtype == "bf16" else 4
+    if xch_comp == "compensated":
+        item *= 2
+    return item
+
+
+def exchange_rows(exchange: str, num_parts: int, rows_per_shard: int,
+                  send_cols: int = 0) -> int:
+    """Feature rows ONE device puts on the wire per exchange round.
+
+    halo: the send map ships ``send_cols`` rows to each of ``num_parts``
+    destinations (send_idx is [P, P, K]); allgather: the shard contributes
+    its padded ``rows_per_shard`` once (fan-out is the fabric's job, not
+    payload); ring: the shard's rows forwarded on each of P-1 hops."""
+    if exchange == "halo":
+        return num_parts * send_cols
+    if exchange == "ring":
+        return max(num_parts - 1, 0) * rows_per_shard
+    return rows_per_shard  # allgather / single-device all_gather
+
+
+def wire_bytes_per_step(exchange: str, num_parts: int, rows_per_shard: int,
+                        widths: Iterable[int], send_cols: int = 0,
+                        xch_dtype: str = "fp32",
+                        xch_comp: str = "plain") -> int:
+    """Static per-device wire bytes for one train step: one exchange per
+    aggregation at each feature width in ``widths`` (a GCN forward
+    exchanges at every layer's output width; backward re-exchanges — the
+    caller decides which passes to count).  Pure Python on static
+    geometry: fold the result into the traced program as a constant."""
+    rows = exchange_rows(exchange, num_parts, rows_per_shard, send_cols)
+    item = wire_itemsize(xch_dtype, xch_comp)
+    return int(rows * item * sum(int(w) for w in widths))

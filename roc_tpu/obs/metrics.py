@@ -1,0 +1,92 @@
+"""Metrics registry + exporters: one stream for train/balance telemetry.
+
+Before this module the repo had two metric sinks with two shapes:
+`TrainStats` (a dataclass bench.py flattens) and the balance JSONL trace
+(`balance/telemetry.py`).  The registry unifies them by *wrapping* a
+:class:`TelemetryBuffer` — every record goes through the same
+``{"type": <kind>, **fields}`` envelope and the same best-effort JSONL
+writer, so a `-obs` run's metrics stream and a `-balance-trace` stream are
+one format (and, when both are on without an explicit balance path, one
+file).  Exporters: the JSONL stream itself, an optional Prometheus
+textfile (node_exporter textfile-collector format) of the latest scalar
+per series, and the in-memory `records` tail that bench.py stamps into
+artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import List, Optional, Tuple
+
+from roc_tpu.balance.telemetry import TelemetryBuffer
+
+_RECORD_TAIL = 4096  # in-memory records kept for bench/report consumers
+
+
+class MetricsRegistry:
+    """Named-record sink over the shared telemetry JSONL schema."""
+
+    def __init__(self, telemetry: Optional[TelemetryBuffer] = None,
+                 jsonl_path: str = ""):
+        self.telemetry = telemetry if telemetry is not None \
+            else TelemetryBuffer(trace_path=jsonl_path)
+        # (kind, fields) tail + latest scalar per "<kind>_<field>" series
+        self.records: deque = deque(maxlen=_RECORD_TAIL)
+        self.latest: dict = {}
+
+    def emit(self, kind: str, /, **fields):
+        """One record: JSONL line (shared schema) + in-memory tail.
+        ``kind`` is positional-only — watchdog alerts carry a "kind"
+        FIELD of their own (slow-epoch/straggler)."""
+        self.telemetry.record_event(kind, **fields)
+        self.records.append((kind, dict(fields)))
+        for k, v in fields.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            self.latest[f"{kind}_{k}"] = float(v)
+
+    def of_kind(self, kind: str) -> List[dict]:
+        return [f for k, f in self.records if k == kind]
+
+    def series(self, kind: str, field: str) -> List[float]:
+        """One field's trajectory across records of ``kind`` (bench.py's
+        grad-norm trajectory comes from here)."""
+        return [float(f[field]) for k, f in self.records
+                if k == kind and field in f]
+
+    def write_prometheus(self, path: str) -> bool:
+        """Latest scalar per series as a Prometheus textfile (best-effort,
+        like every exporter here: observability must never kill a run)."""
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            lines = []
+            for name in sorted(self.latest):
+                metric = "roc_" + "".join(
+                    c if c.isalnum() or c == "_" else "_" for c in name)
+                lines.append(f"{metric} {self.latest[name]:.10g}")
+            with open(path, "w", encoding="utf-8") as f:
+                f.write("\n".join(lines) + "\n")
+            return True
+        except OSError:
+            return False
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Read a metrics/telemetry JSONL stream (skips unparseable lines —
+    a crashed run may leave a torn last line)."""
+    import json
+    out: List[dict] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out

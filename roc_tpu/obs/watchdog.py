@@ -1,0 +1,136 @@
+"""Runtime perf watchdog: EWMA epoch-time regressions + shard stragglers.
+
+The dynamic counterpart to the static analyzer's gates (roc_tpu/analysis):
+PR 3 proves a program *can't* silently grow collectives or retraces, but
+the round-5 8.5x forced-vs-auto anomaly (docs/PERF.md) was harness state —
+byte-identical HLO, wildly different wall-clock — which only a runtime
+detector can catch.  The watchdog keeps an EWMA of epoch wall time and
+flags any epoch slower than ``ratio`` x the mean; on binned runs the EWMA
+can be *seeded* from the committed kernel-budget predictions
+(tools/kernel_budgets.json steps_total x the measured per-grid-step
+overhead), so the very first epochs are already checked against what the
+cost model says the kernel floor should be.
+
+Per-shard stragglers: `observe_shards` flags any probe time above
+``straggler_ratio`` x the shard median — the balancer feeds it the same
+probe samples its cost model fits, so a straggler alert lands in the
+telemetry JSONL next to the balance round that should fix it.
+
+Alerts are plain dicts (JSONL-ready, same `{"type": ...}` envelope as
+balance telemetry once emitted through the registry); the driver prints
+them under -v and `verdict()` stamps the bench artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+DEFAULT_RATIO = 2.0        # alert when epoch > ratio x EWMA
+DEFAULT_ALPHA = 0.25       # EWMA smoothing (higher = adapts faster)
+DEFAULT_WARMUP = 2         # unseeded: observe this many epochs first
+                           # (epoch 0 carries compile time; never judge it)
+STRAGGLER_RATIO = 2.0      # shard alert when t > ratio x median shard time
+
+
+class PerfWatchdog:
+    """EWMA slow-epoch detector + per-shard straggler check."""
+
+    def __init__(self, ratio: float = DEFAULT_RATIO,
+                 alpha: float = DEFAULT_ALPHA,
+                 warmup: int = DEFAULT_WARMUP,
+                 seed_s: Optional[float] = None,
+                 straggler_ratio: float = STRAGGLER_RATIO):
+        self.ratio = float(ratio)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.straggler_ratio = float(straggler_ratio)
+        self.seeded = bool(seed_s and seed_s > 0)
+        self.ewma: Optional[float] = float(seed_s) if self.seeded else None
+        self.observed = 0
+        self.alerts: List[dict] = []
+
+    def observe_epoch(self, epoch: int, wall_s: float) -> Optional[dict]:
+        """Feed one epoch's wall time; returns an alert dict or None."""
+        wall_s = float(wall_s)
+        armed = self.ewma is not None and \
+            (self.seeded or self.observed >= self.warmup)
+        alert = None
+        if armed and wall_s > self.ratio * self.ewma:
+            alert = {"kind": "slow-epoch", "epoch": int(epoch),
+                     "wall_s": wall_s, "ewma_s": float(self.ewma),
+                     "ratio": wall_s / self.ewma}
+            self.alerts.append(alert)
+            # Clamp the outlier's pull on the mean: one anomaly must not
+            # poison the baseline it was measured against (or the NEXT
+            # slow epoch would look fine by comparison).
+            wall_s = self.ratio * self.ewma
+        if self.observed >= 1 or self.seeded:
+            # epoch 0 of an unseeded run carries jit compile time; start
+            # the average at the first post-compile epoch
+            self.ewma = wall_s if self.ewma is None else \
+                self.alpha * wall_s + (1.0 - self.alpha) * self.ewma
+        self.observed += 1
+        return alert
+
+    def observe_shards(self, epoch: int, times_s) -> List[dict]:
+        """Feed per-shard probe times (balance/manager.py's samples);
+        returns straggler alerts (possibly empty)."""
+        times = [float(t) for t in times_s if t and t > 0]
+        if len(times) < 2:
+            return []
+        med = sorted(times)[len(times) // 2]
+        if med <= 0:
+            return []
+        alerts = []
+        for part, t in enumerate(times):
+            if t > self.straggler_ratio * med:
+                alerts.append({"kind": "straggler", "epoch": int(epoch),
+                               "part": part, "time_s": t,
+                               "median_s": med, "ratio": t / med})
+        self.alerts.extend(alerts)
+        return alerts
+
+    def verdict(self) -> str:
+        """"regressed" if any slow-epoch fired, "straggler" if only shard
+        alerts did, "ok" otherwise — stamped into bench artifacts."""
+        kinds = {a["kind"] for a in self.alerts}
+        if "slow-epoch" in kinds:
+            return "regressed"
+        if "straggler" in kinds:
+            return "straggler"
+        return "ok"
+
+
+# -- budget seeding --------------------------------------------------------
+
+_BUDGETS_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools",
+    "kernel_budgets.json")
+
+
+def seed_for_graph(num_rows: int, num_edges: int,
+                   geometry: str = "default",
+                   path: str = "") -> Optional[float]:
+    """Predicted binned-kernel floor (seconds per aggregation pass) for a
+    graph shape pinned in tools/kernel_budgets.json: the committed
+    steps_total x the measured per-grid-step overhead the binned cost
+    model uses (`_CHUNK_OVERHEAD_S`, 9.6-12.2 us measured on v5e).  None
+    when the shape isn't pinned — the EWMA then warms up from measured
+    epochs instead.  This is a *floor* (one aggregation pass, no matmuls),
+    so seeding only arms the "order of magnitude off" detector early; it
+    never replaces measured epochs, which take over after one EWMA step."""
+    try:
+        with open(path or _BUDGETS_PATH, encoding="utf-8") as f:
+            budgets = json.load(f)
+        from roc_tpu.ops.pallas.binned import _CHUNK_OVERHEAD_S
+        for entry in budgets.values():
+            if entry.get("num_rows") == num_rows and \
+                    entry.get("num_edges") == num_edges:
+                geo = entry["geometries"].get(geometry)
+                if geo:
+                    return float(geo["steps_total"]) * _CHUNK_OVERHEAD_S
+    except (OSError, ValueError, KeyError, ImportError):
+        pass
+    return None

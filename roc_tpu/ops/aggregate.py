@@ -287,8 +287,9 @@ def scatter_gather_matmul(x, plans: AggregatePlans, num_rows: int,
     is exact in bf16, so error comes only from rounding the features), while
     "default" trades ~1e-2 relative error for single-pass MXU throughput.
     """
-    return _matmul_run(x, plans.fwd_obi, plans.fwd_edst, plans.fwd_esrc,
-                       num_rows, precision)
+    with jax.named_scope("roc_matmul_agg"):
+        return _matmul_run(x, plans.fwd_obi, plans.fwd_edst, plans.fwd_esrc,
+                           num_rows, precision)
 
 
 def _mm_fwd(x, plans, num_rows, table_rows, precision):

@@ -72,6 +72,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# stdlib-only tracer entry point (no obs package body is pulled in here)
+from roc_tpu.obs.tracer import span as _obs_span
+
 SB = 512      # source rows per x block (phase-1 streaming unit)
 CH = 2048     # edge slots per phase-1 chunk
 # Staging write granularity (rows; multiple of the bf16 sublane 16).  Swept
@@ -800,7 +803,9 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     cache = _plan_cache_path(edge_src, edge_dst, num_rows, table_rows,
                              group_row_target, geom)
     if cache is not None and os.path.exists(cache):
-        plan = _plan_cache_load(cache, num_rows, table_rows, geom)
+        with _obs_span("plan_cache_load", rows=num_rows,
+                       edges=len(edge_src)):
+            plan = _plan_cache_load(cache, num_rows, table_rows, geom)
         if plan is not None:
             return plan
     if len(edge_src) >= (1 << 20) and native.available():
@@ -1888,19 +1893,22 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False,
             # adjacent groups interleaved (gating re-checked against the
             # REAL padded width — the plan-build gate used a model H)
             S = int(plan.f_blk.shape[0])
-            out = _fused_run(xp, plan.f_blk, plan.f_blk2, plan.f_obi,
-                             plan.f_meta, plan.f_dsrc, plan.f_ddst,
-                             plan.f_rows, S, C2, out_rows, interpret,
-                             exact, geom)
+            with jax.named_scope("roc_binned_fused"):
+                out = _fused_run(xp, plan.f_blk, plan.f_blk2, plan.f_obi,
+                                 plan.f_meta, plan.f_dsrc, plan.f_ddst,
+                                 plan.f_rows, S, C2, out_rows, interpret,
+                                 exact, geom)
             return out[:plan.num_rows, :H].astype(x.dtype)
 
         def fbody(_, gplan):
             srcl, blk, blk2, dsrc, ddst, dstl, obi, first = gplan
-            stg = _p1_flat_run(xp, blk, blk2, dsrc, ddst, srcl, C1,
-                               stg_rows, interpret, exact, geom)
-            out_g = _p2_run(stg, obi, first, dstl, C2,
-                            plan.bins_per_group * geom.rb, interpret,
-                            exact, geom)
+            with jax.named_scope("roc_binned_p1_flat"):
+                stg = _p1_flat_run(xp, blk, blk2, dsrc, ddst, srcl, C1,
+                                   stg_rows, interpret, exact, geom)
+            with jax.named_scope("roc_binned_p2"):
+                out_g = _p2_run(stg, obi, first, dstl, C2,
+                                plan.bins_per_group * geom.rb, interpret,
+                                exact, geom)
             return None, out_g
 
         _, outs = jax.lax.scan(
@@ -1913,11 +1921,13 @@ def run_binned(x, plan: BinnedPlan, interpret: bool = False,
 
     def body(_, gplan):
         srcl, off, blk, dstl, obi, first = gplan
-        stg = _p1_run(xp, blk, off, srcl, C1, stg_rows, interpret, exact,
-                      geom)
-        out_g = _p2_run(stg, obi, first, dstl, C2,
-                        plan.bins_per_group * geom.rb, interpret, exact,
-                        geom)
+        with jax.named_scope("roc_binned_p1"):
+            stg = _p1_run(xp, blk, off, srcl, C1, stg_rows, interpret,
+                          exact, geom)
+        with jax.named_scope("roc_binned_p2"):
+            out_g = _p2_run(stg, obi, first, dstl, C2,
+                            plan.bins_per_group * geom.rb, interpret,
+                            exact, geom)
         return None, out_g
 
     _, outs = jax.lax.scan(

@@ -49,15 +49,20 @@ class Adam:
 
     def update(self, params, grads, state: AdamState, alpha):
         """One step; pure/jittable.  ``alpha`` is the (host-decayed) base LR."""
-        t = state.t + 1
-        tf = t.astype(jnp.float32)
-        alpha_t = alpha * jnp.sqrt(1.0 - self.beta2 ** tf) / (1.0 - self.beta1 ** tf)
+        with jax.named_scope("roc_adam_update"):
+            t = state.t + 1
+            tf = t.astype(jnp.float32)
+            alpha_t = (alpha * jnp.sqrt(1.0 - self.beta2 ** tf)
+                       / (1.0 - self.beta1 ** tf))
 
-        b1, b2, wd, eps = self.beta1, self.beta2, self.weight_decay, self.epsilon
-        gt = jax.tree.map(lambda g, w: g + wd * w, grads, params)
-        new_m = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, state.m, gt)
-        new_v = jax.tree.map(lambda v, g: b2 * v + (1.0 - b2) * g * g, state.v, gt)
-        new_params = jax.tree.map(
-            lambda w, m, v: w - alpha_t * m / (jnp.sqrt(v) + eps),
-            params, new_m, new_v)
-        return new_params, AdamState(new_m, new_v, t)
+            b1, b2 = self.beta1, self.beta2
+            wd, eps = self.weight_decay, self.epsilon
+            gt = jax.tree.map(lambda g, w: g + wd * w, grads, params)
+            new_m = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g,
+                                 state.m, gt)
+            new_v = jax.tree.map(lambda v, g: b2 * v + (1.0 - b2) * g * g,
+                                 state.v, gt)
+            new_params = jax.tree.map(
+                lambda w, m, v: w - alpha_t * m / (jnp.sqrt(v) + eps),
+                params, new_m, new_v)
+            return new_params, AdamState(new_m, new_v, t)

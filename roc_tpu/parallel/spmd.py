@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from roc_tpu import ops
+from roc_tpu import obs, ops
 from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph.partition import (Partition, edge_block_arrays,
                                      edge_block_arrays_t, partition_graph)
@@ -724,19 +724,25 @@ def _wire_up(y, gd_block, dtype, H: int):
 def _exchange(gd_block, exchange: str, x):
     """Materialize the per-shard source table for a [S, H] local tensor:
     local rows ++ halo rows (one all_to_all) or the all-gathered tensor.
-    (Ring mode never builds a table — see _ring_aggregate.)"""
+    (Ring mode never builds a table — see _ring_aggregate.)
+    named_scope: pure HLO metadata (xprof grouping for -profile traces —
+    the op-count budget audit is blind to it)."""
     H = x.shape[-1]
     if exchange == "halo":
-        send = _wire_down(jnp.take(x, gd_block.send_idx, axis=0),
-                          gd_block)                             # [P, K, H]
-        recv = jax.lax.all_to_all(send, PARTS_AXIS,
-                                  split_axis=0, concat_axis=0)
-        halo = _wire_up(recv, gd_block, x.dtype, H)
-        return jnp.concatenate(
-            [x, halo.reshape(-1, H)], axis=0)                   # [S+P*K, H]
-    table = jax.lax.all_gather(_wire_down(x, gd_block), PARTS_AXIS,
-                               tiled=True)                      # [P*S, H]
-    return _wire_up(table, gd_block, x.dtype, H)
+        with jax.named_scope("roc_halo_exchange"):
+            with jax.named_scope("roc_wire_down"):
+                send = _wire_down(jnp.take(x, gd_block.send_idx, axis=0),
+                                  gd_block)                     # [P, K, H]
+            recv = jax.lax.all_to_all(send, PARTS_AXIS,
+                                      split_axis=0, concat_axis=0)
+            with jax.named_scope("roc_wire_up"):
+                halo = _wire_up(recv, gd_block, x.dtype, H)
+            return jnp.concatenate(
+                [x, halo.reshape(-1, H)], axis=0)               # [S+P*K, H]
+    with jax.named_scope("roc_allgather_exchange"):
+        table = jax.lax.all_gather(_wire_down(x, gd_block), PARTS_AXIS,
+                                   tiled=True)                  # [P*S, H]
+        return _wire_up(table, gd_block, x.dtype, H)
 
 
 def _ring_aggregate(gd_block, shard_nodes: int, x, aggr: str):
@@ -1294,8 +1300,11 @@ class SpmdTrainer(BaseTrainer):
                 plans=None, ring_plans=ring_plans, backend=backend,
                 mode="ring", precision=cfg.aggregate_precision,
                 xch_dtype=xd, xch_round=xr, xch_comp=xc)
-        self.halo = build_halo_maps(self.part) \
-            if self._exchange_mode == "halo" else None
+        if self._exchange_mode == "halo":
+            with obs.span("halo_build", parts=self.part.num_parts):
+                self.halo = build_halo_maps(self.part)
+        else:
+            self.halo = None
         if backend == "matmul" and cfg.aggregate_backend == "auto":
             # The global viability check (BaseTrainer's resolve) sees the
             # whole-graph geometry; the per-shard plan only spans the halo
@@ -1310,10 +1319,13 @@ class SpmdTrainer(BaseTrainer):
             if AUTO_BINNED and binned_viable(
                     S_, table_rows, int(self.part.num_edges_valid.max())):
                 backend = "binned"
-        return shard_graph(self.part, self.halo, backend,
-                           cfg.aggregate_precision, gat_backend=gat_backend,
-                           halo_overlap=self._halo_overlap(),
-                           xch=self._xch_meta())
+        with obs.span("plan_build", backend=backend,
+                      parts=self.part.num_parts):
+            return shard_graph(self.part, self.halo, backend,
+                               cfg.aggregate_precision,
+                               gat_backend=gat_backend,
+                               halo_overlap=self._halo_overlap(),
+                               xch=self._xch_meta())
 
     def _build_graph_perhost(self, backend: str,
                              gat_backend: str = "xla") -> ShardedGraphData:
@@ -1679,7 +1691,8 @@ class SpmdTrainer(BaseTrainer):
         # static half of jax's own cache key).  This is what lets the
         # retrace guard (analysis/retrace.py) assert literal zero.
         mem_plan = getattr(self, "mem_plan", None)
-        sig = (S, exchange, k,
+        obs_on = bool(self.config.obs)
+        sig = (S, exchange, k, obs_on,
                mem_plan.key() if mem_plan is not None else None,
                jax.tree_util.tree_structure(gd),
                tuple((tuple(leaf.shape), str(leaf.dtype))
@@ -1714,10 +1727,30 @@ class SpmdTrainer(BaseTrainer):
 
         gd_specs = jax.tree.map(lambda a: P(PARTS_AXIS), gd)
 
+        # In-graph metrics channel (obs/channel.py): the contract is zero
+        # host syncs, zero NEW collectives, zero retraces.  Norms use
+        # values the step already replicates (grads after its psum, the
+        # updated params); wire bytes are a trace-time constant from the
+        # static exchange geometry (one forward exchange per aggregation;
+        # backward roughly doubles it); edge counts reduce only the local
+        # block, one scalar per device.
+        if obs_on:
+            wire_bytes = obs.channel.wire_bytes_per_step(
+                "allgather" if gd.mode == "edge" else exchange,
+                self.part.num_parts, S, self._aggregate_widths(),
+                send_cols=(gd.send_idx.shape[-1]
+                           if gd.send_idx is not None else 0),
+                xch_dtype=gd.xch_dtype, xch_comp=gd.xch_comp)
+            metric_specs = {"grad_norm": P(), "param_norm": P(),
+                            "wire_bytes": P(), "edges": P(PARTS_AXIS)}
+            step_out_specs = (P(), P(), P(), metric_specs)
+        else:
+            step_out_specs = (P(), P(), P())
+
         @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
                  in_specs=(P(), P(), P(PARTS_AXIS), P(PARTS_AXIS),
                            P(PARTS_AXIS), gd_specs, P(), P()),
-                 out_specs=(P(), P(), P()))
+                 out_specs=step_out_specs)
         def step_shard(params, opt_state, x, labels, mask, gd, key, alpha):
             # this body only runs while jax traces it — a retrace counter
             _retrace.note_trace("train_step")
@@ -1732,7 +1765,19 @@ class SpmdTrainer(BaseTrainer):
             loss = jax.lax.psum(loss_l, PARTS_AXIS)
             new_params, new_opt = optimizer.update(params, grads, opt_state,
                                                    alpha)
-            return new_params, new_opt, loss
+            if not obs_on:
+                return new_params, new_opt, loss
+            metrics = {
+                "grad_norm": obs.channel.global_norm(grads),
+                "param_norm": obs.channel.global_norm(new_params),
+                # float32: exact for any realistic per-step byte count's
+                # leading digits, and immune to the x64-disabled int trap
+                "wire_bytes": jnp.float32(wire_bytes),
+                # live in-edges targeting this device's rows ([1] per
+                # device -> a [num_devices] global, one count per shard)
+                "edges": jnp.sum(gd.in_degree).astype(jnp.int32)[None],
+            }
+            return new_params, new_opt, loss, metrics
 
         @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
                  in_specs=(P(), P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS),
@@ -1782,19 +1827,18 @@ class SpmdTrainer(BaseTrainer):
         and optimizer state are node-independent (GCN/GAT weights are
         [H_in, H_out]) — no weight migration, only data placement moves.
         """
-        import time as _time
         assert self._balance_supported(), \
             "reshard: unsupported trainer mode (see _balance_supported)"
-        t0 = _time.perf_counter()
-        old = self.part
-        self.part = partition_graph(
-            self.dataset.graph, old.num_parts,
-            bounds=np.asarray(new_bounds, np.int64),
-            shard_nodes=old.shard_nodes, shard_edges=old.shard_edges)
-        gd = self._build_graph_full(self._backend_resolved,
-                                    self._gat_backend_resolved)
-        self._place_data(gd)
-        self._build_steps(gd)
+        with obs.span("reshard", parts=self.part.num_parts) as sp:
+            old = self.part
+            self.part = partition_graph(
+                self.dataset.graph, old.num_parts,
+                bounds=np.asarray(new_bounds, np.int64),
+                shard_nodes=old.shard_nodes, shard_edges=old.shard_edges)
+            gd = self._build_graph_full(self._backend_resolved,
+                                        self._gat_backend_resolved)
+            self._place_data(gd)
+            self._build_steps(gd)
         if self.config.verbose:
             self._log_shard_stats()
-        return _time.perf_counter() - t0
+        return sp.dur_s

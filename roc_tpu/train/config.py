@@ -113,7 +113,22 @@ class Config:
                                   # steps, budget diff when the config has
                                   # a budgets.json entry, RetraceGuard in
                                   # record mode around train()
-    profile_dir: str = ""         # write a jax.profiler trace of epochs 3-5
+    profile_dir: str = ""         # write a jax.profiler trace (window set
+                                  # by -profile-epochs; default 3:3)
+    profile_epochs: str = ""      # profiler window "START:COUNT" relative
+                                  # to this call's first epoch ("" = "3:3",
+                                  # the historical 3-post-compile-epochs
+                                  # default; only meaningful with -profile)
+    obs: bool = False             # unified runtime observability
+                                  # (roc_tpu/obs): record host spans, ride
+                                  # loss/grad-norm/wire-byte metrics on the
+                                  # jitted step's outputs (fetched once per
+                                  # epoch — zero host syncs in jit), run
+                                  # the perf watchdog, export trace.json +
+                                  # metrics.jsonl under -obs-dir
+    obs_dir: str = ""             # obs artifact dir ("" with -obs on ->
+                                  # "roc_obs"; trace.json / metrics.jsonl /
+                                  # metrics.prom)
     multihost: bool = False       # jax.distributed.initialize() before run
     perhost_load: bool = False    # each process reads only its parts' .lux
                                   # byte ranges (pod-scale; needs -file)
@@ -193,6 +208,18 @@ class Config:
             raise SystemExit("-bf16-storage is incompatible with "
                              "-aggr-precision exact (bf16 storage rounds "
                              "features; exact promises fp32 end to end)")
+        # ROC_OBS / ROC_OBS_DIR mirror -obs / -obs-dir for driverless entry
+        # points (bench.py, audit/test fixtures) — same env the span tracer
+        # reads at import, so cfg.obs and tracer state agree.
+        if env.get("ROC_OBS"):
+            self.obs = env["ROC_OBS"] == "1"
+        if env.get("ROC_OBS_DIR"):
+            self.obs_dir = env["ROC_OBS_DIR"]
+        if self.obs and not self.obs_dir:
+            self.obs_dir = "roc_obs"
+        if env.get("ROC_PROFILE_EPOCHS"):
+            self.profile_epochs = env["ROC_PROFILE_EPOCHS"]
+        self.profile_window()  # validate eagerly (SystemExit if bad)
 
     def mem_budget_bytes(self) -> int:
         """-mem-budget in bytes (0 = unset; driver falls back to the
@@ -202,6 +229,22 @@ class Config:
     def exchange_mode(self) -> str:
         """Effective exchange mode ('halo' | 'allgather' | 'ring')."""
         return self.exchange or ("halo" if self.halo else "allgather")
+
+    def profile_window(self) -> tuple:
+        """-profile-epochs "START:COUNT" -> (start_offset, count).  START
+        is relative to the train() call's first epoch (so resumes keep the
+        post-compile intent); default 3:3 is the historical hard-coded
+        window.  SystemExit on malformed input, like every knob here."""
+        spec = self.profile_epochs or "3:3"
+        try:
+            start_s, count_s = spec.split(":")
+            start, count = int(start_s), int(count_s)
+            if start < 0 or count < 1:
+                raise ValueError
+        except ValueError:
+            raise SystemExit(f"bad profile_epochs {spec!r} "
+                             "(want START:COUNT, e.g. 0:1 or 3:3)")
+        return start, count
 
 
 def parse_args(argv: List[str]) -> Config:
@@ -251,6 +294,14 @@ def parse_args(argv: List[str]) -> Config:
                    action="store_true")
     p.add_argument("-analyze", dest="analyze", action="store_true")
     p.add_argument("-profile", dest="profile_dir", default="")
+    p.add_argument("-profile-epochs", dest="profile_epochs", default="",
+                   help="profiler window START:COUNT relative to the first "
+                        "epoch (default 3:3)")
+    p.add_argument("-obs", action="store_true",
+                   help="runtime observability: host spans + in-graph "
+                        "metrics + perf watchdog (roc_tpu/obs)")
+    p.add_argument("-obs-dir", dest="obs_dir", default="",
+                   help="obs artifact dir (default roc_obs)")
     p.add_argument("-multihost", action="store_true")
     p.add_argument("-perhost", dest="perhost_load", action="store_true")
     p.add_argument("-edge-shard", dest="edge_shard", nargs="?", const="on",

@@ -17,12 +17,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import time
 
 import jax
 import jax.numpy as jnp
 
-from roc_tpu import ops
+from roc_tpu import obs, ops
 from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph.datasets import Dataset
 from roc_tpu.models.model import GraphCtx, Model
@@ -138,20 +137,23 @@ def dense_graph_data(graph, backend: str = "xla",
         backend, graph.num_edges, graph.num_nodes, graph.num_nodes,
         graph.col_idx, graph.dst_idx, storage_dtype=storage_dtype)
     plans = None
-    if backend == "matmul":
-        plans = ops.build_aggregate_plans(
-            graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes)
-    elif backend == "binned":
-        # fwd rides the geometry the resolution already chose (if any);
-        # bwd (the transposed direction) still chooses its own
-        plans = ops.build_binned_plans(
-            graph.col_idx, graph.dst_idx, graph.num_nodes, graph.num_nodes,
-            geom=(geom or "auto", "auto"), storage_dtype=storage_dtype)
-    gat_plans = None
-    if gat_backend == "plan":
-        from roc_tpu.ops.edge import build_gat_plans
-        gat_plans = build_gat_plans(graph.col_idx, graph.dst_idx,
-                                    graph.num_nodes, graph.num_nodes)
+    with obs.span("plan_build", backend=backend):
+        if backend == "matmul":
+            plans = ops.build_aggregate_plans(
+                graph.col_idx, graph.dst_idx, graph.num_nodes,
+                graph.num_nodes)
+        elif backend == "binned":
+            # fwd rides the geometry the resolution already chose (if any);
+            # bwd (the transposed direction) still chooses its own
+            plans = ops.build_binned_plans(
+                graph.col_idx, graph.dst_idx, graph.num_nodes,
+                graph.num_nodes, geom=(geom or "auto", "auto"),
+                storage_dtype=storage_dtype)
+        gat_plans = None
+        if gat_backend == "plan":
+            from roc_tpu.ops.edge import build_gat_plans
+            gat_plans = build_gat_plans(graph.col_idx, graph.dst_idx,
+                                        graph.num_nodes, graph.num_nodes)
     return DenseGraphData(
         edge_src=jnp.asarray(graph.col_idx, jnp.int32),
         edge_dst=jnp.asarray(graph.dst_idx, jnp.int32),
@@ -231,6 +233,7 @@ class BaseTrainer:
         # Edge-sharded aggregation is a multi-device strategy; SpmdTrainer
         # resolves "auto" from measured partition skew during _setup.
         self._use_edge_shard = False
+        self._obs_init()
         self._setup()
         self.balancer = None
         if config.balance_every:
@@ -240,9 +243,17 @@ class BaseTrainer:
                 # the dataset's feature width and the wire itemsize (bf16
                 # storage and bf16 features both exchange 2-byte rows).
                 wire2 = config.bf16_storage or config.use_bf16
+                # A -obs run funnels balance telemetry through the obs
+                # metrics stream (one JSONL, one schema) unless the user
+                # pinned a separate -balance-trace path.
+                shared = self._metrics.telemetry \
+                    if (self._metrics is not None
+                        and not config.balance_trace) else None
                 self.balancer = BalanceManager.from_config(
                     config, halo_width=self.dataset.in_dim,
-                    halo_itemsize=2 if wire2 else 4)
+                    halo_itemsize=2 if wire2 else 4, telemetry=shared)
+                # stragglers the balancer probes feed the same watchdog
+                self.balancer.watchdog = self.watchdog
             elif config.verbose:
                 print("# -balance-every: online balancing needs the SPMD "
                       "vertex-sharded path (parts > 1, k = 1, no "
@@ -255,6 +266,79 @@ class BaseTrainer:
         """Can this trainer apply a repartition mid-run?  The SPMD trainer
         overrides this for the modes ``reshard`` handles."""
         return False
+
+    # -- observability (roc_tpu/obs) --------------------------------------
+    def _obs_init(self):
+        """Arm the obs layer before _setup so plan-build spans record and
+        the step builders see cfg.obs when shaping their outputs."""
+        cfg = self.config
+        self._metrics = None
+        self.watchdog = None
+        self._last_step_metrics = None
+        if not cfg.obs:
+            return
+        obs.enable(True)
+        jsonl = os.path.join(cfg.obs_dir, "metrics.jsonl") \
+            if cfg.obs_dir else ""
+        if jsonl:
+            try:
+                os.makedirs(cfg.obs_dir, exist_ok=True)
+            except OSError:
+                jsonl = ""  # keep the in-memory registry; skip the file
+        self._metrics = obs.MetricsRegistry(jsonl_path=jsonl)
+        g = self.dataset.graph
+        # EWMA seeded from the committed kernel-budget prediction when the
+        # graph shape is pinned there (binned runs); None -> measured warmup
+        self.watchdog = obs.PerfWatchdog(
+            seed_s=obs.seed_for_graph(g.num_nodes, g.num_edges))
+
+    def _obs_epoch(self, epoch: int, wall_s: float, loss, print_fn):
+        """Per-epoch drain: fetch the in-graph metrics pytree (ONE
+        device_get, after the timed window so it never pollutes
+        epoch_times), emit the unified record, feed the watchdog."""
+        if self._metrics is None:
+            return
+        rec = {"epoch": int(epoch), "wall_s": round(float(wall_s), 6),
+               "loss": float(jax.device_get(loss))}
+        if self._last_step_metrics is not None:
+            with obs.span("metrics_fetch"):
+                vals = jax.device_get(self._last_step_metrics)
+            rec["grad_norm"] = float(vals["grad_norm"])
+            rec["param_norm"] = float(vals["param_norm"])
+            rec["wire_bytes"] = int(vals["wire_bytes"])
+            rec["edges_per_shard"] = [int(e) for e in vals["edges"]]
+        self._metrics.emit("metrics", **rec)
+        if self.watchdog is not None:
+            alert = self.watchdog.observe_epoch(epoch, wall_s)
+            if alert is not None:
+                self._metrics.emit("watchdog", **alert)
+                if self.config.verbose:
+                    print_fn(f"# watchdog: epoch {epoch} took "
+                             f"{alert['ratio']:.2f}x the EWMA "
+                             f"({alert['wall_s'] * 1e3:.1f} ms vs "
+                             f"{alert['ewma_s'] * 1e3:.1f} ms)")
+
+    def _obs_finish(self, stats: "TrainStats", print_fn):
+        """End-of-train summary record + artifact export (trace.json /
+        metrics.prom under -obs-dir)."""
+        if self._metrics is None:
+            return
+        cfg = self.config
+        verdict = self.watchdog.verdict() if self.watchdog else "off"
+        self._metrics.emit(
+            "train", epochs=stats.epochs, total_s=round(stats.total_s, 6),
+            final_loss=stats.final_loss, watchdog_verdict=verdict,
+            watchdog_alerts=len(self.watchdog.alerts)
+            if self.watchdog else 0)
+        if cfg.obs_dir:
+            trace_path = os.path.join(cfg.obs_dir, "trace.json")
+            ok = obs.get_tracer().write_chrome_trace(trace_path)
+            self._metrics.write_prometheus(
+                os.path.join(cfg.obs_dir, "metrics.prom"))
+            if cfg.verbose and ok:
+                print_fn(f"# obs: trace -> {trace_path} "
+                         f"({len(obs.get_tracer().span_types())} span "
+                         f"types); watchdog verdict: {verdict}")
 
     def _resolve_mem_plan(self):
         """Choose this run's activation-memory plan (roc_tpu/memory) from
@@ -345,13 +429,33 @@ class BaseTrainer:
         return {op.attrs["aggr"] for op in self.model.ops
                 if op.kind == "aggregate"}
 
+    def _aggregate_widths(self) -> list:
+        """Feature width at each aggregate/gat op, in op order — the widths
+        a forward pass exchanges at (obs wire-byte accounting).  The op IR
+        stores tensor ids, not dims, so track the last linear's out_dim
+        (builders always aggregate a projected tensor; the input width
+        covers a hypothetical pre-projection aggregate)."""
+        widths, width = [], self.dataset.in_dim
+        for op in self.model.ops:
+            if op.kind == "linear":
+                width = op.attrs["out_dim"]
+            elif op.kind in ("aggregate", "gat"):
+                widths.append(width)
+        return widths
+
     def _model_has_gat(self) -> bool:
         return any(op.kind == "gat" for op in self.model.ops)
 
     def _run_step(self, step_key, alpha):
-        self.params, self.opt_state, loss = self._train_step(
+        out = self._train_step(
             self.params, self.opt_state, self.x, self.labels, self.mask,
             self.gdata, step_key, alpha)
+        if self.config.obs:
+            # the in-graph metrics pytree rides the step outputs; stash it
+            # device-side — _obs_epoch fetches once after the timed window
+            self.params, self.opt_state, loss, self._last_step_metrics = out
+        else:
+            self.params, self.opt_state, loss = out
         return loss
 
     def evaluate(self) -> ops.PerfMetrics:
@@ -375,64 +479,82 @@ class BaseTrainer:
         cfg = self.config
         num_edges = self.dataset.graph.num_edges
         self.epoch_times = []  # wall-clock per epoch (observability the
-        t0 = time.perf_counter()  # reference only had commented out,
-        start = self.epoch        # SURVEY.md §5.1)
-        # Trace up to 3 post-compile epochs; clamp into range so short runs
-        # still produce a trace.
-        prof_start = start + min(3, max(cfg.num_epochs - 1, 0))
-        prof_stop = min(prof_start + 3, start + cfg.num_epochs)
+        start = self.epoch     # reference only had commented out, §5.1)
+        # Profiler window from -profile-epochs (default 3:3 — up to 3
+        # post-compile epochs); clamp into range so short runs still trace.
+        p_off, p_cnt = cfg.profile_window()
+        prof_start = start + min(p_off, max(cfg.num_epochs - 1, 0))
+        prof_stop = min(prof_start + p_cnt, start + cfg.num_epochs)
         tracing = False
         loss = float("nan")
         rebalance_events = []
         peak_hbm = []
         peak_src = ""
-        for epoch in range(start, start + cfg.num_epochs):
-            if cfg.profile_dir and epoch == prof_start:
-                jax.profiler.start_trace(cfg.profile_dir)
-                tracing = True
-            te = time.perf_counter()
-            loss = self.run_epoch()
-            # the sync IS the measurement: an epoch "ends" when its result
-            # reaches the host, not when dispatch returns
-            device_sync(loss)  # roclint: allow(host-sync)
-            self.epoch_times.append(time.perf_counter() - te)
-            hbm, peak_src = self._peak_hbm()
-            peak_hbm.append(hbm)
-            if self.balancer is not None:
-                self.balancer.telemetry.record_epoch(
-                    epoch, self.epoch_times[-1], peak_hbm=hbm,
-                    peak_hbm_source=peak_src)
-            if tracing and epoch + 1 == prof_stop:
-                device_sync(self.params)
-                jax.profiler.stop_trace()
-                tracing = False
-                print_fn(f"# profiler trace written to {cfg.profile_dir}")
-            if epoch % cfg.eval_every == 0:
-                m = jax.device_get(self.evaluate())
-                print_fn(format_metrics(epoch, m))
-            if (cfg.checkpoint_path and cfg.checkpoint_every and
-                    (epoch + 1) % cfg.checkpoint_every == 0):
-                self.save_checkpoint(cfg.checkpoint_path)
-            # Balance round at the epoch boundary (never after the last
-            # epoch of this call — there would be nothing left to speed up).
-            done = epoch + 1 - start
-            if (self.balancer is not None and done < cfg.num_epochs
-                    and done % cfg.balance_every == 0):
-                ev = self.balancer.step(self, epoch + 1,
-                                        cfg.num_epochs - done)
-                if ev is not None:
-                    rebalance_events.append(ev)
-                    if cfg.verbose:
-                        print_fn(f"# balance@{epoch + 1}: {ev['action']} "
-                                 f"(pred gain {ev['rel_gain'] * 100:.1f}%, "
-                                 f"r2 {ev['r2']:.3f})")
-            # After the balance round, so an armed RetraceGuard sees a
-            # reshard's (cache-missing) rebuild as the violation it is.
-            _retrace.epoch_boundary(done)
-        device_sync(self.params)
-        dt = time.perf_counter() - t0
+        with obs.span("train", epochs=cfg.num_epochs) as sp_train:
+            try:
+                for epoch in range(start, start + cfg.num_epochs):
+                    if cfg.profile_dir and epoch == prof_start:
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        tracing = True
+                    # the sync IS the measurement: an epoch "ends" when its
+                    # result reaches the host, not when dispatch returns
+                    with obs.span("epoch", epoch=epoch) as sp_epoch:
+                        with obs.span("step_dispatch"):
+                            loss = self.run_epoch()
+                        with obs.span("device_sync"):
+                            device_sync(loss)
+                    self.epoch_times.append(sp_epoch.dur_s)
+                    hbm, peak_src = self._peak_hbm()
+                    peak_hbm.append(hbm)
+                    if self.balancer is not None:
+                        self.balancer.telemetry.record_epoch(
+                            epoch, self.epoch_times[-1], peak_hbm=hbm,
+                            peak_hbm_source=peak_src)
+                    self._obs_epoch(epoch, sp_epoch.dur_s, loss, print_fn)
+                    if tracing and epoch + 1 == prof_stop:
+                        device_sync(self.params)
+                        jax.profiler.stop_trace()
+                        tracing = False
+                        print_fn(f"# profiler trace written to "
+                                 f"{cfg.profile_dir}")
+                    if epoch % cfg.eval_every == 0:
+                        with obs.span("eval", epoch=epoch):
+                            m = jax.device_get(self.evaluate())
+                        print_fn(format_metrics(epoch, m))
+                    if (cfg.checkpoint_path and cfg.checkpoint_every and
+                            (epoch + 1) % cfg.checkpoint_every == 0):
+                        with obs.span("checkpoint", epoch=epoch):
+                            self.save_checkpoint(cfg.checkpoint_path)
+                    # Balance round at the epoch boundary (never after the
+                    # last epoch — nothing left to speed up).
+                    done = epoch + 1 - start
+                    if (self.balancer is not None and done < cfg.num_epochs
+                            and done % cfg.balance_every == 0):
+                        ev = self.balancer.step(self, epoch + 1,
+                                                cfg.num_epochs - done)
+                        if ev is not None:
+                            rebalance_events.append(ev)
+                            if cfg.verbose:
+                                print_fn(
+                                    f"# balance@{epoch + 1}: "
+                                    f"{ev['action']} (pred gain "
+                                    f"{ev['rel_gain'] * 100:.1f}%, "
+                                    f"r2 {ev['r2']:.3f})")
+                    # After the balance round, so an armed RetraceGuard
+                    # sees a reshard's (cache-missing) rebuild as the
+                    # violation it is.
+                    _retrace.epoch_boundary(done)
+            finally:
+                # profiler-session leak fix: a crash mid-window must still
+                # close the trace, or the next start_trace in the process
+                # dies on the leaked session
+                if tracing:
+                    jax.profiler.stop_trace()
+            device_sync(self.params)
+        dt = sp_train.dur_s
         if cfg.checkpoint_path:
-            self.save_checkpoint(cfg.checkpoint_path)
+            with obs.span("checkpoint"):
+                self.save_checkpoint(cfg.checkpoint_path)
         if cfg.verbose and self.epoch_times:
             # steady-state epoch time: median of post-compile epochs
             steady = sorted(self.epoch_times[2:] or self.epoch_times)
@@ -440,11 +562,13 @@ class BaseTrainer:
             print_fn(f"# {cfg.num_epochs} epochs in {dt:.2f}s "
                      f"(median {med * 1e3:.1f} ms/epoch post-warmup, "
                      f"{num_edges / med / 1e6:.1f}M edges/s)")
-        return TrainStats(
+        stats = TrainStats(
             epoch_times=list(self.epoch_times), total_s=dt,
             epochs=cfg.num_epochs, final_loss=float(device_sync(loss)),
             rebalance_events=rebalance_events,
             peak_hbm_bytes=peak_hbm, peak_hbm_source=peak_src)
+        self._obs_finish(stats, print_fn)
+        return stats
 
     # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
     def save_checkpoint(self, path: str, extra=None):
@@ -486,6 +610,9 @@ class Trainer(BaseTrainer):
         n = self.num_nodes
         self._resolve_mem_plan()
         loss_fn = self._loss_fn()
+        obs_on = self.config.obs
+        if obs_on:
+            from roc_tpu.obs import channel as obs_channel
 
         @jax.jit
         def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
@@ -495,7 +622,18 @@ class Trainer(BaseTrainer):
                 params, x, labels, mask, gctx, key=key, train=True)
             params, opt_state = self.optimizer.update(
                 params, grads, opt_state, alpha)
-            return params, opt_state, loss
+            if not obs_on:
+                return params, opt_state, loss
+            # in-graph metrics channel (obs/channel.py): pure functions of
+            # values already in the program — no syncs, no collectives
+            metrics = {
+                "grad_norm": obs_channel.global_norm(grads),
+                "param_norm": obs_channel.global_norm(params),
+                # single device: nothing crosses a wire
+                "wire_bytes": jnp.float32(0.0),
+                "edges": jnp.sum(gdata.in_degree).astype(jnp.int32)[None],
+            }
+            return params, opt_state, loss, metrics
 
         @jax.jit
         def eval_step(params, x, labels, mask, gdata):

@@ -1,0 +1,422 @@
+"""Observability tests (roc_tpu/obs): tracer schema + nesting, metrics
+channel parity, zero retraces with obs on, watchdog behavior, the span
+overhead bound, and the raw-timing lint rule.
+
+The parity tests are the load-bearing ones: `-obs` must be a pure
+*observer* — bitwise-identical losses/params vs an obs-off run, zero new
+traces across epochs and a same-cut reshard — or the metrics channel is
+changing the thing it measures.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from roc_tpu import obs
+from roc_tpu.analysis import AuditSpec, build_audit_trainer, lint
+from roc_tpu.analysis.retrace import RetraceGuard
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gcn
+from roc_tpu.obs import report as obs_report
+from roc_tpu.obs.tracer import SpanTracer, validate_chrome_trace
+from roc_tpu.obs.watchdog import PerfWatchdog, seed_for_graph
+from roc_tpu.parallel.spmd import SpmdTrainer
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """Trainers with -obs flip the process-global tracer on; restore it so
+    obs state never leaks across tests."""
+    tr = obs.get_tracer()
+    prev = tr.enabled
+    yield
+    tr.enabled = prev
+    tr.clear()
+
+
+def _dataset(n=80, deg=3.0, in_dim=8, classes=3, seed=13):
+    return datasets.synthetic("t", n, deg, in_dim, classes, n_train=20,
+                              n_val=20, n_test=20, seed=seed)
+
+
+# -- tracer ----------------------------------------------------------------
+
+def test_span_nesting_and_chrome_schema():
+    tr = SpanTracer(capacity=16)
+    tr.enabled = True
+    with tr.span("outer", epoch=1):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner"):
+            pass
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["inner", "inner", "outer"]
+    assert [s.depth for s in spans] == [1, 1, 0]
+    outer = spans[-1]
+    assert outer.args == {"epoch": 1}
+    assert outer.dur_ns >= sum(s.dur_ns for s in spans[:2])
+    trace = tr.to_chrome_trace()
+    assert validate_chrome_trace(trace) == []
+    json.dumps(trace)  # Perfetto needs real JSON, not just a dict
+    ev = trace["traceEvents"][-1]
+    assert ev["ph"] == "X" and ev["name"] == "outer"
+    assert ev["args"] == {"epoch": 1}
+
+
+def test_disabled_span_times_but_records_nothing():
+    tr = SpanTracer()
+    assert not tr.enabled
+    with tr.span("quiet") as sp:
+        pass
+    assert sp.dur_s > 0          # dur_s is the repo's timing primitive
+    assert tr.spans() == []      # ...but nothing lands in the ring
+
+
+def test_tracer_ring_capacity_bounds_memory():
+    tr = SpanTracer(capacity=4)
+    tr.enabled = True
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.spans()) == 4
+    assert tr.spans()[-1].name == "s9"
+
+
+def test_validate_chrome_trace_flags_bad_events():
+    assert validate_chrome_trace([]) != []
+    assert validate_chrome_trace({"traceEvents": [{"ph": "X"}]}) != []
+    assert validate_chrome_trace(
+        {"traceEvents": [{"ph": "X", "name": "a", "ts": "oops", "dur": 1,
+                          "pid": 1, "tid": 1}]}) != []
+
+
+# -- watchdog --------------------------------------------------------------
+
+def test_watchdog_fires_on_injected_slow_epoch():
+    wd = PerfWatchdog()
+    for epoch in range(5):
+        assert wd.observe_epoch(epoch, 0.1) is None
+    alert = wd.observe_epoch(5, 0.3)
+    assert alert is not None and alert["kind"] == "slow-epoch"
+    assert alert["ratio"] == pytest.approx(3.0, rel=0.05)
+    assert wd.verdict() == "regressed"
+    # outlier clamping: the anomaly must not poison the EWMA it was
+    # measured against — the next normal epoch stays quiet
+    assert wd.observe_epoch(6, 0.1) is None
+
+
+def test_watchdog_quiet_on_noise():
+    wd = PerfWatchdog()
+    noise = [0.1, 0.102, 0.098, 0.101, 0.099, 0.103, 0.097, 0.1]
+    assert all(wd.observe_epoch(i, t) is None for i, t in enumerate(noise))
+    assert wd.verdict() == "ok" and wd.alerts == []
+
+
+def test_watchdog_seeded_is_armed_from_epoch_zero():
+    wd = PerfWatchdog(seed_s=0.1)
+    alert = wd.observe_epoch(0, 0.5)
+    assert alert is not None and alert["ewma_s"] == pytest.approx(0.1)
+    # unseeded: epoch 0 carries compile time and never trips the detector
+    assert PerfWatchdog().observe_epoch(0, 99.0) is None
+
+
+def test_watchdog_straggler_detection():
+    wd = PerfWatchdog()
+    assert wd.observe_shards(0, [0.1, 0.1, 0.1, 0.1]) == []
+    alerts = wd.observe_shards(1, [0.1, 0.1, 0.1, 0.5])
+    assert len(alerts) == 1 and alerts[0]["part"] == 3
+    assert alerts[0]["kind"] == "straggler"
+    assert wd.verdict() == "straggler"
+    # degenerate inputs never fire
+    assert wd.observe_shards(2, [0.1]) == []
+    assert wd.observe_shards(3, [0.0, 0.0]) == []
+
+
+def test_watchdog_budget_seed():
+    """reddit_scaled is pinned in tools/kernel_budgets.json: the seed is
+    its committed steps_total x the binned per-grid-step overhead."""
+    from roc_tpu.ops.pallas.binned import _CHUNK_OVERHEAD_S
+    seed = seed_for_graph(32768, 4194304)
+    assert seed == pytest.approx(3358 * _CHUNK_OVERHEAD_S)
+    assert seed_for_graph(17, 17) is None  # unpinned shape -> warmup EWMA
+
+
+# -- metrics registry ------------------------------------------------------
+
+def test_metrics_registry_shares_telemetry_schema(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    reg = obs.MetricsRegistry(jsonl_path=path)
+    reg.emit("metrics", epoch=0, loss=1.5, grad_norm=2.0)
+    reg.emit("metrics", epoch=1, loss=1.25, grad_norm=1.0)
+    reg.emit("watchdog", kind="slow-epoch", epoch=1, ratio=3.0)
+    recs = obs.load_jsonl(path)
+    # every record rides the balance-telemetry envelope: {"type": kind, ...}
+    assert [r["type"] for r in recs] == ["metrics", "metrics", "watchdog"]
+    assert recs[1]["loss"] == 1.25
+    assert reg.series("metrics", "loss") == [1.5, 1.25]
+    assert reg.of_kind("watchdog")[0]["ratio"] == 3.0
+    prom = str(tmp_path / "m.prom")
+    assert reg.write_prometheus(prom)
+    text = open(prom).read()
+    assert "roc_metrics_loss 1.25" in text
+    assert "roc_metrics_grad_norm 1" in text
+
+
+def test_load_jsonl_skips_torn_lines(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text('{"type": "metrics", "epoch": 0}\n{"type": "me')
+    assert obs.load_jsonl(str(path)) == [{"type": "metrics", "epoch": 0}]
+
+
+# -- driver integration ----------------------------------------------------
+
+def _trainer(obs_on, tmp_path=None, **kw):
+    cfg = dict(layers=[8, 4, 3], num_epochs=4, eval_every=1000,
+               dropout_rate=0.0, obs=obs_on)
+    if obs_on:
+        cfg["obs_dir"] = str(tmp_path / "obs") if tmp_path else ""
+    cfg.update(kw)
+    cfg = Config(**cfg)
+    return Trainer(cfg, _dataset(), build_gcn(cfg.layers, 0.0))
+
+
+def test_obs_is_a_pure_observer(tmp_path):
+    """Losses and params of an obs-on run are bitwise identical to the
+    obs-off run: the metrics channel observes the step, never changes it."""
+    ta = _trainer(False)
+    tb = _trainer(True, tmp_path)
+    for _ in range(4):
+        la = float(jax.device_get(ta.run_epoch()))
+        lb = float(jax.device_get(tb.run_epoch()))
+        assert la == lb  # bitwise, not approx
+    for ka in ta.params:
+        np.testing.assert_array_equal(np.asarray(ta.params[ka]),
+                                      np.asarray(tb.params[ka]))
+
+
+def test_metrics_channel_values(tmp_path):
+    """The in-graph metrics match an independent host-side recompute."""
+    from roc_tpu.obs import channel
+    tr = _trainer(True, tmp_path)
+    tr.run_epoch()
+    vals = jax.device_get(tr._last_step_metrics)
+    # param_norm was computed in-graph on the updated params — recompute
+    # from the live (updated) param pytree
+    expect = float(jax.jit(channel.global_norm)(tr.params))
+    assert float(vals["param_norm"]) == pytest.approx(expect, rel=1e-6)
+    assert float(vals["grad_norm"]) > 0.0
+    assert float(vals["wire_bytes"]) == 0.0   # single device: no wire
+    assert int(vals["edges"][0]) == int(
+        np.asarray(jax.device_get(tr.gdata.in_degree)).sum())
+
+
+def test_obs_train_artifacts_and_span_types(tmp_path):
+    """A -obs run emits a Perfetto-loadable trace with >= 8 span types and
+    the unified JSONL metrics stream."""
+    obs.get_tracer().clear()
+    tr = _trainer(True, tmp_path, num_epochs=4, eval_every=2,
+                  aggregate_backend="matmul", checkpoint_every=2,
+                  checkpoint_path=str(tmp_path / "ck.npz"))
+    tr.train(print_fn=lambda *a, **k: None)
+    types = obs.get_tracer().span_types()
+    assert {"train", "epoch", "step_dispatch", "device_sync",
+            "metrics_fetch", "eval", "checkpoint", "plan_build"} <= types
+    assert len(types) >= 8
+    trace = json.load(open(tmp_path / "obs" / "trace.json"))
+    assert validate_chrome_trace(trace) == []
+    recs = obs.load_jsonl(str(tmp_path / "obs" / "metrics.jsonl"))
+    kinds = [r["type"] for r in recs]
+    assert kinds.count("metrics") == 4 and kinds[-1] == "train"
+    for r in recs:
+        if r["type"] == "metrics":
+            assert {"epoch", "wall_s", "loss", "grad_norm", "param_norm",
+                    "wire_bytes", "edges_per_shard"} <= set(r)
+    assert recs[-1]["watchdog_verdict"] in ("ok", "regressed", "straggler")
+    assert (tmp_path / "obs" / "metrics.prom").exists()
+    # the report CLI's renderer digests both artifacts
+    text = obs_report.report(str(tmp_path / "obs" / "trace.json"),
+                             str(tmp_path / "obs" / "metrics.jsonl"))
+    assert "step_dispatch" in text and "verdict" in text
+
+
+def test_spmd_obs_wire_bytes_and_shard_edges(tmp_path):
+    """SPMD halo run: wire_bytes reflects the exchange accounting and
+    edges land per-shard (out_spec P(PARTS_AXIS))."""
+    ds = _dataset(n=400, deg=4.0, in_dim=16, classes=4, seed=3)
+    cfg = Config(layers=[16, 16, 4], num_epochs=3, num_parts=4, halo=True,
+                 eval_every=1000, dropout_rate=0.0, obs=True,
+                 obs_dir=str(tmp_path / "obs"))
+    tr = SpmdTrainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    tr.train(print_fn=lambda *a, **k: None)
+    recs = [r for r in obs.load_jsonl(str(tmp_path / "obs" / "metrics.jsonl"))
+            if r["type"] == "metrics"]
+    assert len(recs) == 3
+    last = recs[-1]
+    assert last["wire_bytes"] > 0
+    assert len(last["edges_per_shard"]) == 4
+    assert sum(last["edges_per_shard"]) > 0
+    from roc_tpu.obs import channel
+    gd = tr.gdata
+    expect = channel.wire_bytes_per_step(
+        "halo", 4, tr.part.shard_nodes, tr._aggregate_widths(),
+        send_cols=gd.send_idx.shape[-1] if gd.send_idx is not None else 0,
+        xch_dtype=gd.xch_dtype, xch_comp=gd.xch_comp)
+    assert last["wire_bytes"] == expect
+
+
+def test_zero_retraces_with_obs(monkeypatch, tmp_path):
+    """The obs acceptance bar: 3 epochs + a same-cut reshard with the
+    metrics channel riding the step add ZERO retraces (mirror of
+    test_analysis.py::test_zero_retraces_across_epochs_and_reshard)."""
+    monkeypatch.setenv("ROC_OBS", "1")
+    monkeypatch.setenv("ROC_OBS_DIR", str(tmp_path / "obs"))
+    spec = AuditSpec("gcn", 2, "matmul", "halo")
+    tr = build_audit_trainer(spec)
+    assert tr.config.obs
+    tr.config.num_epochs = 3
+    with RetraceGuard(warmup=1) as g:
+        tr.train(print_fn=lambda *a, **k: None)
+        assert g.counts["train_step"] >= 1
+        snap = g.snapshot()
+        step_ids = (id(tr._train_step), id(tr._eval_step))
+        tr.reshard(tr.part.bounds)           # same cut, same shapes
+        assert (id(tr._train_step), id(tr._eval_step)) == step_ids
+        g.arm()
+        tr.run_epoch()
+        tr.evaluate()
+        g.assert_no_new_traces(snap)
+
+
+def test_obs_toggle_is_in_the_step_cache_key(monkeypatch, tmp_path):
+    """Flipping obs on the same SPMD trainer rebuilds the step (4-tuple
+    out) instead of aliasing the cached 3-tuple callable."""
+    monkeypatch.setenv("ROC_OBS_DIR", str(tmp_path / "obs"))
+    spec = AuditSpec("gcn", 2, "matmul", "halo")
+    tr = build_audit_trainer(spec)
+    assert not tr.config.obs
+    off_step = tr._train_step
+    tr.config.obs = True
+    tr._obs_init()
+    tr._build_steps(tr.gdata)
+    assert tr._train_step is not off_step
+    tr.run_epoch()
+    assert tr._last_step_metrics is not None
+
+
+# -- overhead gate ---------------------------------------------------------
+
+def test_span_overhead_bound():
+    """Per-span cost (the always-on steady state) stays under the report
+    gate; obs measures itself — no raw clocks in this test."""
+    tr = SpanTracer()
+    tr.enabled = True
+    reps = 2000
+    with tr.span("gate") as gate:
+        for _ in range(reps):
+            with tr.span("probe"):
+                pass
+    assert gate.dur_s / reps < obs_report.MAX_SPAN_OVERHEAD_S
+
+
+def test_obs_epoch_overhead_within_two_percent(tmp_path):
+    """Accounting form of the <=2% CPU overhead acceptance bar: the obs
+    spans' own cost per epoch (span bookkeeping + the one metrics fetch)
+    against the measured epoch wall time."""
+    ds = _dataset(n=2000, deg=6.0, in_dim=32, classes=4, seed=5)
+    cfg = Config(layers=[32, 32, 4], num_epochs=6, eval_every=1000,
+                 dropout_rate=0.0, obs=True, obs_dir=str(tmp_path / "obs"))
+    tr = Trainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+    obs.get_tracer().clear()
+    tr.train(print_fn=lambda *a, **k: None)
+    epochs = sorted(s.dur_s for s in obs.get_tracer().spans()
+                    if s.name == "epoch")
+    med_epoch = epochs[len(epochs) // 2]
+    fetches = [s.dur_s for s in obs.get_tracer().spans()
+               if s.name == "metrics_fetch"]
+    # measure the per-span bookkeeping cost with obs itself
+    probe = SpanTracer()
+    probe.enabled = True
+    reps = 1000
+    with probe.span("gate") as gate:
+        for _ in range(reps):
+            with probe.span("p"):
+                pass
+    per_span = gate.dur_s / reps
+    spans_per_epoch = len(obs.get_tracer().spans()) / max(len(epochs), 1)
+    cost = spans_per_epoch * per_span + sorted(fetches)[len(fetches) // 2]
+    assert cost <= 0.02 * med_epoch, (cost, med_epoch)
+
+
+def test_selftest_passes():
+    msgs = []
+    assert obs_report.selftest(out=msgs.append) == 0
+    assert any("ok" in m for m in msgs)
+
+
+# -- config ----------------------------------------------------------------
+
+def test_profile_window_parsing(monkeypatch):
+    assert Config().profile_window() == (3, 3)
+    assert Config(profile_epochs="0:1").profile_window() == (0, 1)
+    with pytest.raises(SystemExit):
+        Config(profile_epochs="nope")
+    with pytest.raises(SystemExit):
+        Config(profile_epochs="3")
+    with pytest.raises(SystemExit):
+        Config(profile_epochs="-1:2")
+    monkeypatch.setenv("ROC_PROFILE_EPOCHS", "5:2")
+    assert Config().profile_window() == (5, 2)
+
+
+def test_obs_env_mirror(monkeypatch):
+    monkeypatch.setenv("ROC_OBS", "1")
+    cfg = Config()
+    assert cfg.obs and cfg.obs_dir == "roc_obs"
+    monkeypatch.setenv("ROC_OBS_DIR", "/tmp/elsewhere")
+    assert Config().obs_dir == "/tmp/elsewhere"
+    monkeypatch.setenv("ROC_OBS", "0")
+    assert not Config().obs
+
+
+# -- raw-timing lint rule --------------------------------------------------
+
+_TIMING_SRC = ("import time\n"
+               "def bench(fn):\n"
+               "    t0 = time.perf_counter()\n"
+               "    fn()\n"
+               "    return time.perf_counter() - t0\n")
+
+
+def test_lint_raw_timing_positive():
+    fs = lint.lint_source(_TIMING_SRC, "roc_tpu/train/somefile.py")
+    assert any(f.rule == "raw-timing" for f in fs), fs
+    # perf_counter_ns windows count too
+    src_ns = _TIMING_SRC.replace("perf_counter()", "perf_counter_ns()")
+    fs = lint.lint_source(src_ns, "roc_tpu/train/somefile.py")
+    assert any(f.rule == "raw-timing" for f in fs), fs
+    # module-level windows (script idiom) count too
+    src_mod = ("import time\nt0 = time.perf_counter()\nwork()\n"
+               "dt = time.perf_counter() - t0\n")
+    fs = lint.lint_source(src_mod, "tools/somescript.py")
+    assert any(f.rule == "raw-timing" for f in fs), fs
+
+
+def test_lint_raw_timing_exemptions():
+    # roc_tpu/obs/ is the sanctioned clock site
+    assert lint.lint_source(_TIMING_SRC, "roc_tpu/obs/tracer.py") == []
+    # inline fixtures (non-.py paths) never fire the rule
+    assert [f for f in lint.lint_source(_TIMING_SRC, "<string>")
+            if f.rule == "raw-timing"] == []
+    # a start with no `- t0` use is not a timing window
+    src = "import time\ndef f():\n    t0 = time.perf_counter()\n    return 0\n"
+    assert lint.lint_source(src, "roc_tpu/train/x.py") == []
+    # waivers work like every other rule
+    waived = _TIMING_SRC.replace(
+        "t0 = time.perf_counter()",
+        "t0 = time.perf_counter()  # roclint: allow(raw-timing)")
+    assert lint.lint_source(waived, "roc_tpu/train/x.py") == []

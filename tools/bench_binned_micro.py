@@ -20,6 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
+from roc_tpu import obs
 from roc_tpu.ops.pallas.binned import (
     build_binned_plan, run_binned, _p1_run, _p2_run, _pad_to, SB, CH2)
 
@@ -48,11 +49,11 @@ def timeit(name, fn):
     fn()  # warmup/compile
     sync_out = fn()
     _ = sync(sync_out)
-    t = time.perf_counter()
-    for _ in range(REPS):
-        out = fn()
-    _ = sync(out)
-    dt = (time.perf_counter() - t) / REPS
+    with obs.span("bench_micro", name=name, reps=REPS) as sp:
+        for _ in range(REPS):
+            out = fn()
+        _ = sync(out)
+    dt = sp.dur_s / REPS
     print(f"{name}: {dt*1e3:.1f} ms")
     return dt
 

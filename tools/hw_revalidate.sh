@@ -125,6 +125,16 @@ timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 10 -parts 4 -v 2>&1 | tail -2 | tee -a "$LOG"
 timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 10 -parts 4 -bf16-storage -v 2>&1 | tail -2 | tee -a "$LOG"
+
+note "3g. obs-trace capture: the shipped-defaults bench under ROC_OBS=1 —"
+note "    hands back the first HOST-side span trace from real hardware"
+note "    (trace.json loads in Perfetto next to an xprof trace) plus the"
+note "    watchdog verdict against the budget-seeded EWMA; artifacts under"
+note "    /tmp/roc_obs_hw"
+ROC_OBS=1 ROC_OBS_DIR=/tmp/roc_obs_hw ROC_BENCH_EPOCHS=5 \
+    timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+timeout 120 python -m roc_tpu.obs report -dir /tmp/roc_obs_hw 2>&1 \
+    | tee -a "$LOG"
 fi
 
 if [ "$START" -le 4 ]; then

@@ -36,6 +36,14 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python tools/check_kernel_budgets.py || {
     echo "preflight: kernel step budgets RED" >&2; exit 1; }
 
+# Obs gate: the observability layer holds its own contracts — tracer
+# span nesting + Chrome-trace schema validity, watchdog fires on an
+# injected 3x slow epoch / stays quiet on noise, and the span overhead
+# bound (stdlib-only, so this costs ~100 ms).
+echo "== obs selftest =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m roc_tpu.obs selftest || {
+    echo "preflight: obs selftest RED" >&2; exit 1; }
+
 # Memory-plan determinism gate: the same config must produce a
 # byte-identical plan JSON (the plan participates in the step cache key —
 # nondeterminism here means phantom retraces and unreproducible OOM

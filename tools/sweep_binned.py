@@ -103,11 +103,12 @@ def run_one(sb, ch, slot, rb, ch2, grt, flat=0):
     interp = jax.default_backend() != "tpu"   # CPU smoke: interpret mode
     run = jax.jit(lambda x, plan: jnp.sum(B.run_binned(x, plan, interp)))
     v = float(np.asarray(run(x, plan)))     # compile + correctness value
-    t = time.perf_counter()
-    for _ in range(5):
-        out = run(x, plan)
-    _ = np.asarray(out)
-    dt = (time.perf_counter() - t) / 5
+    from roc_tpu import obs
+    with obs.span("bench_sweep", sb=sb, ch=ch, reps=5) as sp:
+        for _ in range(5):
+            out = run(x, plan)
+        _ = np.asarray(out)
+    dt = sp.dur_s / 5
     print(f"SB={sb} CH={ch} SLOT={slot} RB={rb} CH2={ch2} grt={grt} "
           f"flat={flat}: {dt*1e3:.1f} ms  (G={G} C1={C1} C2={C2} "
           f"pad1={pad1:.2f} pad2={pad2:.2f} build={tb:.0f}s "
