@@ -158,6 +158,13 @@ MEM_PLAN = os.environ.get("ROC_MEM_PLAN", "keep")
 # excluded from vs_baseline and the canonical last-known-good persist —
 # the reference figures are fp32-storage numbers.
 DTYPE = "bf16" if os.environ.get("ROC_BF16_STORAGE") == "1" else "fp32"
+# ROC_MEGAFUSE=1 (likewise the Config.__post_init__ env): whole-layer
+# aggregate->linear megakernel fusion.  Same artifact policy as bf16
+# storage: every artifact is stamped with the fusion level, mega legs
+# annotate the metric and are excluded from vs_baseline and the
+# last-known-good persist — the reference figures are two-pass numbers,
+# and the fused program is a different trace.
+FUSION = "mega" if os.environ.get("ROC_MEGAFUSE") == "1" else "none"
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -175,7 +182,8 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + ("" if INTER == "uniform" else f"_inter-{INTER}")
           + ("" if BALANCE_EVERY == 0 else f"_balance{BALANCE_EVERY}")
           + ("" if MEM_PLAN == "keep" else f"_mem-{MEM_PLAN}")
-          + ("" if DTYPE == "fp32" else f"_{DTYPE}"))
+          + ("" if DTYPE == "fp32" else f"_{DTYPE}")
+          + ("" if FUSION == "none" else f"_{FUSION}"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -518,9 +526,10 @@ def run():
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
         if MODEL == "gcn" and CANONICAL_SHAPE and REORDER == "off"
         and BALANCE_EVERY == 0 and MEM_PLAN == "keep"
-        and DTYPE == "fp32" else None,
+        and DTYPE == "fp32" and FUSION == "none" else None,
         "backend": resolved,                   # what auto resolved to
         "dtype": DTYPE,                        # feature-storage dtype
+        "fusion": FUSION,                      # layer-fusion level
         "platform": jax.default_backend(),
         "edges_per_sec_per_chip": round(edges_per_sec_per_chip),
         "model_tflops_per_epoch": round(flops / 1e12, 4),
@@ -611,7 +620,7 @@ def run():
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0
             and MEM_PLAN == "keep" and "binned_flat" not in result
-            and DTYPE == "fp32"
+            and DTYPE == "fp32" and FUSION == "none"
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

@@ -113,14 +113,28 @@ def _op_forward_s(op, in_dim: int, out_dim: int, rows: int,
 
 
 def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
-                   fixed_bytes: int = 0) -> ModelEstimate:
+                   fixed_bytes: int = 0,
+                   megafuse: bool = False) -> ModelEstimate:
     """Per-layer byte/recompute estimates for ``model`` at a per-device
     shard of ``rows`` node rows and ``edges`` edges.
 
     ``itemsize`` is the activation element width (4 for fp32, 2 for bf16);
     ``fixed_bytes`` is the plan-independent resident set (params, optimizer
     state, placed node tensors) the caller already knows.
+
+    ``megafuse=True`` applies the whole-layer megakernel's tensor
+    elimination: for every ``mega_matches`` pair the aggregate's output
+    (and the linear's, when a trailing relu folds in) never materializes,
+    so those tensors contribute zero to ``bytes_full``/``bytes_saved`` and
+    the DP plans over the fused layer's real residual set.
     """
+    fused_gone: set = set()
+    if megafuse:
+        from roc_tpu.models.model import mega_matches
+        for rec in mega_matches(model).values():
+            fused_gone.add(rec["aggregate"].out)
+            if rec["final"] is not rec["linear"]:
+                fused_gone.add(rec["linear"].out)
     dims = _op_out_dims(model)
     per_layer: Dict[int, List] = {}
     for op in model.ops:
@@ -133,7 +147,8 @@ def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
         for op in per_layer[idx]:
             in_dim = dims[op.inputs[0]]
             out_dim = dims[op.out]
-            out_bytes = rows * out_dim * itemsize
+            out_bytes = 0 if op.out in fused_gone \
+                else rows * out_dim * itemsize
             t = _op_forward_s(op, in_dim, out_dim, rows, edges)
             full += out_bytes
             fwd += t
@@ -191,7 +206,9 @@ def estimate_for_trainer(trainer) -> ModelEstimate:
     fixed = fixed_bytes_for(trainer.model, rows, ds.features.shape[1],
                             ds.num_classes, edges, itemsize)
     return estimate_model(trainer.model, rows, edges, itemsize=itemsize,
-                          fixed_bytes=fixed)
+                          fixed_bytes=fixed,
+                          megafuse=getattr(trainer.config, "megafuse",
+                                           False))
 
 
 # -- XLA cross-checks (analysis/hlo_audit.py lowering machinery) ----------

@@ -48,6 +48,13 @@ class GraphCtx(NamedTuple):
     # -> [N, K, F]; built by the same driver/spmd code that builds
     # ``aggregate`` (it owns the halo/all_gather exchange).
     attend: Optional[Callable] = None
+    # whole-layer megakernel hook: (x, w, activation, aggr) -> out or None.
+    # When set, `apply` offers each `mega_matches`-eligible
+    # aggregate→linear(→relu) pair to it; a None return means "not fusable
+    # here" (VMEM gate, hybrid plan, kill switch) and the unfused op
+    # sequence runs unchanged.  Default None keeps every existing program
+    # byte-identical — the HLO budget audit pins that.
+    fuse_linear: Optional[Callable] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +70,64 @@ class OpNode:
     inputs: tuple             # input tensor ids
     out: int                  # output tensor id
     attrs: dict               # op-specific attributes
+
+
+def mega_matches(model: "Model") -> Dict[int, dict]:
+    """Find megakernel-eligible ``aggregate → linear (→ relu)`` chains.
+
+    Returns ``{op_index_of_aggregate: record}`` where record carries the
+    matched ``linear`` node, the resolved activation ("none"/"relu"), the
+    ``final`` node whose output tensor (and ckpt tag) the fused op takes
+    over, and the op indices to ``skip`` when fusion succeeds.
+
+    Eligibility — all structural, decided from the static op IR:
+
+    * the aggregate is sum or avg and its output feeds exactly one op,
+      a ``linear`` (so skipping the intermediate drops no other use and
+      the ``[rows, H_in]`` aggregate never needs to materialize);
+    * the linear's activation is none or relu (the kernel's in-register
+      epilogue); a separate single-consumer relu node directly after an
+      activation-free linear is folded in the same way;
+    * everything sits in the same builder layer, so fusion never crosses
+      an ``end_layer`` checkpoint boundary and the memory planner's
+      per-layer accounting stays well-formed;
+    * no matched intermediate is the logits tensor.
+
+    GIN/SAGE layers match; GCN's ``linear → norm → aggregate → norm``
+    shape does not (the aggregate feeds a norm, not a linear) — its win
+    needs norm-folding, a separate item.
+    """
+    consumers: Dict[int, List[int]] = {}
+    for i, op in enumerate(model.ops):
+        for t in op.inputs:
+            consumers.setdefault(t, []).append(i)
+    logits_id = model.logits.id if model.logits is not None else -1
+    found: Dict[int, dict] = {}
+    for i, op in enumerate(model.ops):
+        if op.kind != "aggregate" or op.attrs.get("aggr") not in ("sum",
+                                                                  "avg"):
+            continue
+        cons = consumers.get(op.out, [])
+        if len(cons) != 1 or op.out == logits_id:
+            continue
+        lin = model.ops[cons[0]]
+        if (lin.kind != "linear"
+                or lin.attrs.get("activation") not in ("none", "relu")
+                or lin.attrs.get("layer") != op.attrs.get("layer")):
+            continue
+        activation, skip, final = lin.attrs["activation"], [cons[0]], lin
+        if activation == "none" and lin.out != logits_id:
+            lcons = consumers.get(lin.out, [])
+            nxt = model.ops[lcons[0]] if len(lcons) == 1 else None
+            if (nxt is not None and nxt.kind == "activation"
+                    and nxt.attrs.get("mode") == "relu"
+                    and nxt.attrs.get("layer") == lin.attrs.get("layer")):
+                activation, final = "relu", nxt
+                skip.append(lcons[0])
+        found[i] = {"aggregate": op, "linear": lin,
+                    "activation": activation, "final": final,
+                    "skip": tuple(skip)}
+    return found
 
 
 class Model:
@@ -211,8 +276,24 @@ class Model:
         residuals.  Off by default: untagged programs are byte-identical to
         the pre-planner ones, which the HLO budget audit pins."""
         vals: Dict[int, jnp.ndarray] = {0: x}
-        for op in self.ops:
+        matches = mega_matches(self) if gctx.fuse_linear is not None else {}
+        skipped: set = set()
+        for idx, op in enumerate(self.ops):
+            if idx in skipped:
+                continue
             a = vals[op.inputs[0]]
+            if idx in matches:
+                m = matches[idx]
+                fused = gctx.fuse_linear(
+                    a, params[m["linear"].attrs["param"]],
+                    m["activation"], op.attrs["aggr"])
+                if fused is not None:
+                    if ckpt_names:
+                        fused = _checkpoint_name(fused,
+                                                 m["final"].attrs["ckpt"])
+                    vals[m["final"].out] = fused
+                    skipped.update(m["skip"])
+                    continue
             if op.kind == "dropout":
                 if train:
                     assert key is not None, "training dropout needs a PRNG key"

@@ -334,7 +334,8 @@ class BinnedPlans(NamedTuple):
 def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
                        num_rows: int, table_rows: int,
                        geom=None,
-                       storage_dtype: str = "fp32") -> BinnedPlans:
+                       storage_dtype: str = "fp32",
+                       fuse_linear: bool = False) -> BinnedPlans:
     """Schedules for out = A@x (fwd) and grad_x = A^T@grad (bwd) — the bwd
     plan swaps roles exactly as the reference re-launches its forward
     kernel transposed (scattergather_kernel.cu:160-170).
@@ -350,8 +351,18 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
 
     A forward geometry with ``hub_minc`` set (choose_geometry's hybrid
     verdict, or an explicit caller) splits the edges: the binned pair
-    covers only the dense-cell edges and ``mm`` carries the rest."""
-    from roc_tpu.ops.pallas.binned import (Geometry, _default_geom,
+    covers only the dense-cell edges and ``mm`` carries the rest.
+
+    ``fuse_linear`` applies the megakernel's layer-handoff pricing to the
+    FORWARD direction's auto-choice only (the backward plan runs the plain
+    transposed aggregation; its grad matmuls are separate either way).
+
+    ROC_BINNED_GEOM=<preset name> (binned.GEOM_PRESETS) overrides the
+    forward auto-choice for hardware A/B runs that must isolate one
+    variable (tools/hw_revalidate.sh step 4c)."""
+    import os
+    from roc_tpu.ops.pallas.binned import (GEOM_PRESETS, Geometry,
+                                           _default_geom,
                                            build_binned_plan,
                                            choose_geometry, split_hub_edges)
     # Geometry is itself a NamedTuple: only a PLAIN pair is (fwd, bwd)
@@ -360,14 +371,19 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
     else:
         fwd_spec, bwd_spec = geom, geom
 
-    def pick(spec, src, dst, n, t):
+    def pick(spec, src, dst, n, t, fuse=False, forced=""):
         if spec != "auto":
             return spec
+        if forced:
+            return GEOM_PRESETS[forced]
         g, _ = choose_geometry(src, dst, n, t, force=True,
-                               storage_dtype=storage_dtype)
+                               storage_dtype=storage_dtype,
+                               fuse_linear=fuse)
         return g or _default_geom()
 
-    fwd_geom = pick(fwd_spec, edge_src, edge_dst, num_rows, table_rows)
+    fwd_geom = pick(fwd_spec, edge_src, edge_dst, num_rows, table_rows,
+                    fuse=fuse_linear,
+                    forced=os.environ.get("ROC_BINNED_GEOM", ""))
     es, ed = np.asarray(edge_src), np.asarray(edge_dst)
     mm = None
     if getattr(fwd_geom, "hub_minc", 0):
@@ -479,3 +495,61 @@ def _bn_bwd(interpret, precision, plans, g):
 
 
 scatter_gather_binned.defvjp(_bn_fwd, _bn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Whole-layer megakernel (round 10): aggregate -> linear (-> ReLU) fused
+# into one Pallas grid — see roc_tpu/ops/pallas/binned.py run_binned_linear.
+# ---------------------------------------------------------------------------
+
+def _unfused_layer(x, w, plans, interpret, precision, activation):
+    """The two-pass reference composition the megakernel must match:
+    binned sum-aggregation, then ops.linear (fp32 `highest` matmul +
+    activation).  Forward oracle for parity tests AND the backward's
+    recompute target."""
+    from roc_tpu.ops.linear import linear
+    return linear(scatter_gather_binned(x, plans, interpret, precision),
+                  w, activation)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def scatter_gather_linear_binned(x, w, plans: BinnedPlans,
+                                 interpret: bool = False,
+                                 precision: str = "fast",
+                                 activation: str = "none"):
+    """linear(sum-aggregate(x), w)[, ReLU] through the megakernel when the
+    plan's fused schedule and the VMEM gate allow it, else the identical
+    two-pass composition.  Differentiable w.r.t. x and w.
+
+    Backward reuses the two-pass path by construction: the VJP replays
+    ``scatter_gather_binned`` -> ``ops.linear`` under jax.vjp, so the
+    gradient program (plans.bwd transposed aggregation, the linear's
+    three GEMMs) is bitwise the one the unfused layer would have run —
+    no fused backward to validate, and the megakernel stays a pure
+    forward-bandwidth optimization.  Hybrid plans (plans.mm) are not
+    eligible: their matmul side adds outside the kernel, so callers
+    route those through the unfused ops."""
+    from roc_tpu.ops.pallas.binned import run_binned_linear
+    assert plans.mm is None, \
+        "megakernel fusion requires a pure binned plan (no hybrid side)"
+    return run_binned_linear(x, w, plans.fwd, interpret, precision,
+                             activation)
+
+
+def _bnl_fwd(x, w, plans, interpret, precision, activation):
+    return scatter_gather_linear_binned(
+        x, w, plans, interpret, precision, activation), (x, w, plans)
+
+
+def _bnl_bwd(interpret, precision, activation, res, g):
+    x, w, plans = res
+    _, vjp = jax.vjp(
+        lambda xx, ww: _unfused_layer(xx, ww, plans, interpret, precision,
+                                      activation), x, w)
+    gx, gw = vjp(g)
+    zero = jax.tree.map(
+        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
+    return gx, gw, zero
+
+
+scatter_gather_linear_binned.defvjp(_bnl_fwd, _bnl_bwd)

@@ -160,8 +160,11 @@ class Geometry(NamedTuple):
     # to one whole bf16 (16, 128) sublane tile, so staging and the
     # size-classed copies ride bf16 — half the DMA bytes of the fp32
     # 8-row unit for ~2x its cell-padding tax.  Only flat geometries use
-    # it; "exact" precision needs fp32 staging and run_binned rejects the
-    # combination.  New fields MUST append after this one: native plan
+    # it — FINAL (round 10): the slot-padded schedule will never grow a
+    # bf16 staging unit, because its 8-row cells slice a bf16 (16, 128)
+    # tile mid-sublane at every cell boundary; check() rejects non-flat
+    # unit=16 so the dead end stays unreachable.  "exact" precision needs
+    # fp32 staging and run_binned rejects the combination.  New fields MUST append after this one: native plan
     # builders and the sweep tooling consume tuple(geom)[:5], and the
     # plan-cache key/version hash the whole tuple.
     unit: int = 0
@@ -274,6 +277,36 @@ GEOM_FLAT_SPARSE = Geometry(sb=1024, ch=2048, slot=16, rb=1024, ch2=2048,
 GEOM_FLAT_BF16 = GEOM_FLAT._replace(unit=16)
 GEOM_FLAT_SPARSE_BF16 = GEOM_FLAT_SPARSE._replace(unit=16)
 
+# Megakernel candidates (round 10, docs/DESIGN.md §Megakernel): the
+# aggregate->linear megakernel runs on any flat plan whose fused schedule
+# attaches (ch == ch2, group staging within _FUSE_MAX_STG_ROWS), so the
+# mega presets ARE the fused-eligible flat geometries under explicit
+# names — no new window shapes, no Geometry field (the plan-cache key and
+# native builders stay untouched).  choose_geometry(fuse_linear=True)
+# prices the difference instead: candidates whose schedule cannot feed
+# the megakernel pay the eliminated intermediate's HBM round trip.
+GEOM_MEGA = GEOM_FLAT
+GEOM_MEGA_SPARSE = GEOM_FLAT_SPARSE
+GEOM_MEGA_BF16 = GEOM_FLAT_BF16
+
+# Named presets for the ROC_BINNED_GEOM escape hatch (build_binned_plans):
+# force the auto-chosen FORWARD geometry to a specific preset, for
+# hardware A/B runs that must isolate one variable — e.g. hw_revalidate
+# step 4c runs both megakernel legs at "flat" so the measured delta is
+# fusion, not the cost model's geometry pick.
+GEOM_PRESETS = {
+    "wide": GEOM_WIDE,
+    "mid": GEOM_MID,
+    "mid_wide": GEOM_MID_WIDE,
+    "sparse": GEOM_SPARSE,
+    "sparse_wide": GEOM_SPARSE_WIDE,
+    "xsparse": GEOM_XSPARSE,
+    "flat": GEOM_FLAT,
+    "flat_sparse": GEOM_FLAT_SPARSE,
+    "flat_bf16": GEOM_FLAT_BF16,
+    "flat_sparse_bf16": GEOM_FLAT_SPARSE_BF16,
+}
+
 # Staging ceiling per bin group, in rows (~1 GiB bf16 at H=256).  Fewer
 # groups = less per-(group, block) chunk-rounding padding in phase 1 at the
 # cost of a proportionally larger staging buffer; ROC_BINNED_GROUP_ROWS
@@ -315,6 +348,9 @@ class BinnedPlan:
       f_blk/f_blk2/f_obi [S] x blocks + GLOBAL output bin per step (p1
                              steps repeat the previous p2 step's bin)
       f_dsrc/f_ddst [S, KD]  staging-copy run lists (kind 0; else -1)
+      f_last  [S]            1 iff the step is the LAST real p2 chunk of
+                             its output bin (the megakernel's in-register
+                             activation point; pad steps carry 0)
     """
     p1_srcl: jnp.ndarray
     p1_off: jnp.ndarray
@@ -332,6 +368,7 @@ class BinnedPlan:
     f_obi: jnp.ndarray = None
     f_dsrc: jnp.ndarray = None
     f_ddst: jnp.ndarray = None
+    f_last: jnp.ndarray = None
     num_rows: int = dataclasses.field(metadata={"static": True}, default=0)
     table_rows: int = dataclasses.field(metadata={"static": True}, default=0)
     bins_per_group: int = dataclasses.field(
@@ -347,7 +384,8 @@ class BinnedPlan:
 _PLAN_DATA_FIELDS = [
     "p1_srcl", "p1_off", "p1_blk", "p2_dstl", "p2_obi", "p2_first",
     "p1_blk2", "p1_dsrc", "p1_ddst",
-    "f_meta", "f_rows", "f_blk", "f_blk2", "f_obi", "f_dsrc", "f_ddst"]
+    "f_meta", "f_rows", "f_blk", "f_blk2", "f_obi", "f_dsrc", "f_ddst",
+    "f_last"]
 
 jax.tree_util.register_dataclass(
     BinnedPlan,
@@ -429,6 +467,11 @@ _SLOT_DMA_S = 31e-9           # per staging slot DMA (SLOT sweep delta)
 # products shape: 306k windows for 2.45M rows regardless of density.
 _MM_CHUNK_S = 2.9e-6
 _MODEL_H = 256                # nominal width: plans are H-independent
+# HBM bandwidth for the fuse_linear round-trip credit (choose_geometry):
+# one [rows, H] fp32 intermediate written by the aggregate and read back
+# by the linear is what the megakernel eliminates.  Matches
+# roc_tpu/memory/estimator.PEAK_BW (v5e ~819 GB/s).
+_HBM_BW = 819e9
 # VMEM feasibility for choose_geometry's candidates, at the nominal model
 # width and bf16 staging (the "fast" precision the hardware path runs):
 # phase 1 holds the ch x sb one-hot, double gbuf, and an sb x H x block;
@@ -648,6 +691,72 @@ def _plan_steps(cell_blk: np.ndarray, cell_bin: np.ndarray,
     return padded, G * C1, G * C2
 
 
+def fused_plan_steps(cell_blk: np.ndarray, cell_bin: np.ndarray,
+                     cnt: np.ndarray, geom: Geometry, num_rows: int,
+                     table_rows: int, num_edges: int):
+    """Exact fused/megakernel grid step count for these cells, or None
+    when no fused schedule would attach (non-flat geometry, ch != ch2, or
+    group staging beyond _FUSE_MAX_STG_ROWS).  The fused grid runs REAL
+    chunks only — _attach_fused skips pad chunks — so its step count is
+    pad8(sum c1_per_g + sum bin_chunks), vs the two-pass G*C1 + G*C2
+    (per-group max-padded) that _plan_steps prices; the gap is what the
+    kernel-budget mega gate pins (tools/check_kernel_budgets.py).  Same
+    arithmetic as _flat_plan_steps/_attach_fused, O(cells)."""
+    r = _fused_sched_stats(cell_blk, cell_bin, cnt, geom, num_rows,
+                           table_rows, num_edges)
+    return None if r is None else r[0]
+
+
+def _fused_sched_stats(cell_blk, cell_bin, cnt, geom, num_rows, table_rows,
+                       num_edges):
+    """(fused_steps, C2) for these cells, or None when no fused schedule
+    attaches — the shared arithmetic behind fused_plan_steps and the
+    kernel-budget tool's megakernel row (which also needs C2 to evaluate
+    _mega_vmem_ok offline)."""
+    if not (geom.flat and geom.ch == geom.ch2):
+        return None
+    num_bins = max(-(-num_rows // geom.rb), 1)
+    num_blocks = max(-(-table_rows // geom.sb), 1)
+    bpg = max(min(num_bins,
+                  int(geom.group_rows / max(num_edges / num_bins, 1)),
+                  _K2_CAP // num_blocks), 1)
+    G = -(-num_bins // bpg)
+    U = geom.unit_rows
+    cell_units = -(-cnt // U)
+    gb = (cell_bin // bpg) * num_blocks + cell_blk
+    gb_uniq, gb_inv = np.unique(gb, return_inverse=True)
+    gb_units = np.bincount(gb_inv, weights=cell_units).astype(np.int64)
+    c1_per_g, _ = _flat_pack(gb_uniq // num_blocks, gb_units,
+                             geom.ch // U, G)
+    u2 = geom.ch2 // U
+    bin_units = np.bincount(cell_bin, weights=cell_units,
+                            minlength=num_bins).astype(np.int64)
+    bin_chunks = np.maximum(-(-bin_units // u2), 1)
+    c2_per_g = np.bincount(np.arange(num_bins) // bpg, weights=bin_chunks,
+                           minlength=G)
+    C2 = max(int(c2_per_g.max(initial=0)), 1)
+    if C2 * geom.ch2 > _FUSE_MAX_STG_ROWS:
+        return None
+    steps = _pad_to(max(int(c1_per_g.sum()) + int(bin_chunks.sum()), 1), 8)
+    return steps, C2
+
+
+def predicted_layer_hbm_bytes(num_rows: int, h_in: int, h_out: int,
+                              mega: bool = False,
+                              itemsize: int = 4) -> int:
+    """Per-layer HBM bytes of the aggregate->linear handoff, OUTSIDE the
+    x-block streaming and staging traffic the two modes share: the
+    unfused path writes the [rows, H_in] aggregate to HBM and reads it
+    back for the matmul; the megakernel never materializes it.  Both
+    read the weight once and write the [rows, H_out] output.  Pinned by
+    the kernel-budget mega entry and tests/test_binned_flat.py: the drop
+    must be >= the intermediate's write + read."""
+    out = num_rows * h_out * itemsize + h_in * h_out * 4
+    if mega:
+        return out
+    return out + 2 * num_rows * h_in * itemsize
+
+
 def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,
                     geom: Geometry) -> int:
     """ACTUAL slot-padded staging rows for this graph at this geometry:
@@ -677,7 +786,8 @@ def staging_bytes_for(edge_src: np.ndarray, edge_dst: np.ndarray,
 def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
                     num_rows: int, table_rows: int,
                     candidates=None, force: bool = False,
-                    storage_dtype: str = "fp32"):
+                    storage_dtype: str = "fp32",
+                    fuse_linear: bool = False):
     """Pick the fastest-modeled binned geometry for this graph, or None if
     the matmul backend's modeled cost beats every candidate (VERDICT r3
     item 3: products-density graphs get a measured-stats policy instead of
@@ -701,7 +811,20 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     dtype the trainer will run.  bf16 storage adds the 16-row bf16-unit
     flat presets to the candidate list (their halved staging bytes only
     exist when the input rides bf16; an fp32 run gains nothing and would
-    pay the doubled cell padding)."""
+    pay the doubled cell padding).
+
+    ``fuse_linear``: price candidates for an aggregate->linear layer that
+    the megakernel may fuse (round 10).  A candidate whose schedule
+    CANNOT feed the megakernel (non-flat, ch != ch2, oversized groups, or
+    a hybrid split) pays the rest of the layer: the eliminated
+    intermediate's HBM round trip (one [rows, _MODEL_H] fp32 write + read
+    at _HBM_BW) plus the separate linear pass's launch windows (one
+    _CHUNK_OVERHEAD_S per 512-row output window — the same currency the
+    kernel-budget mega gate uses).  A mega-eligible candidate is instead
+    priced at its FUSED schedule: real chunks only, the W matmul riding
+    the existing steps, no second pass.  VMEM admission is NOT checked
+    here (H is unknown until trace time; the kernel's own gate falls back
+    to the two-pass flat schedule, which this candidate also runs well)."""
     E = len(edge_src)
     if E == 0:
         return None, 0.0
@@ -714,6 +837,14 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
          GEOM_FLAT, GEOM_FLAT_SPARSE]
     if candidates is None and storage_dtype == "bf16":
         cands += [GEOM_FLAT_BF16, GEOM_FLAT_SPARSE_BF16]
+    # What a NON-fusable candidate pays on top of aggregation when the
+    # layer could have fused: the intermediate [rows, H] fp32 write + read
+    # the megakernel elides, plus the separate linear pass's launch
+    # windows over the output rows.
+    rt = 0.0
+    if fuse_linear:
+        rt = (2 * num_rows * _MODEL_H * 4 / _HBM_BW
+              + -(-num_rows // 512) * _CHUNK_OVERHEAD_S)
     best, best_t = None, float("inf")
     stats_cache = {}
     for g in cands:
@@ -729,6 +860,15 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
         padded, s1, s2 = _plan_steps(cblk, cbin, cnt, g, num_rows,
                                      table_rows, E)
         t = _binned_cost_model(padded, g, steps1=s1, steps2=s2)
+        if rt:
+            fs = _fused_sched_stats(cblk, cbin, cnt, g, num_rows,
+                                    table_rows, E)
+            if fs is None:
+                t += rt
+            else:
+                # fused layer: real chunks only, matmul in-pipeline —
+                # scale the two-pass aggregation model by the step ratio
+                t *= fs[0] / max(s1 + s2, 1)
         if t < best_t:
             best, best_t = g, t
         # Hybrid variant: the sub-half-full cells' edges go to the matmul
@@ -747,10 +887,11 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
                 table_rows, E - E_thin)
             t_h = (_binned_cost_model(padded_d, g, steps1=s1_d,
                                       steps2=s2_d)
-                   + _matmul_cost(E_thin, num_rows))
+                   + _matmul_cost(E_thin, num_rows)
+                   + rt)    # hybrid plans carry a matmul side: never mega
             if t_h < best_t:
                 best, best_t = g._replace(hub_minc=minc), t_h
-    t_matmul = _matmul_cost(E, num_rows)
+    t_matmul = _matmul_cost(E, num_rows) + rt
     if force or (best is not None and best_t < t_matmul):
         return best, best_t
     return None, t_matmul
@@ -1320,7 +1461,15 @@ def _attach_fused(plan: BinnedPlan) -> BinnedPlan:
     f_dsrc = np.full((S, KD), -1, np.int32)
     f_ddst = np.full((S, KD), -1, np.int32)
     f_meta[:, 0] = 1                           # pad steps are kind=p2
+    # Last real p2 chunk of each output bin: the megakernel applies its
+    # in-register activation there (the bin's accumulation is complete;
+    # the out index is nondecreasing, so no later step reopens it — pad
+    # steps revisit the bin but only add exact zeros, which commute with
+    # ReLU).  Kept as a separate [S] array rather than a fifth f_meta
+    # column so the existing (8, 4) SMEM BlockSpec stays untouched.
+    f_last = np.zeros(S, np.int32)
     cur_blk = cur_blk2 = cur_obi = 0
+    prev_p2 = -1
     for i, (kind, g, c) in enumerate(steps):
         if kind == 0:
             cur_blk, cur_blk2 = int(blk[g, c]), int(blk2[g, c])
@@ -1329,10 +1478,16 @@ def _attach_fused(plan: BinnedPlan) -> BinnedPlan:
             f_dsrc[i] = dsrc[g, c]
             f_ddst[i] = ddst[g, c]
         else:
-            cur_obi = g * bpg + int(obi[g, c])
+            nxt = g * bpg + int(obi[g, c])
+            if prev_p2 >= 0 and nxt != cur_obi:
+                f_last[prev_p2] = 1
+            cur_obi = nxt
+            prev_p2 = i
             f_meta[i] = (1, g % 2, int(first[g, c]), c)
             f_rows[i] = dstl[g, c * CH:(c + 1) * CH]
         f_blk[i], f_blk2[i], f_obi[i] = cur_blk, cur_blk2, cur_obi
+    if prev_p2 >= 0:
+        f_last[prev_p2] = 1
     if len(steps) < S:                         # pad: revisit the last bin
         f_meta[len(steps):, 1] = steps[-1][1] % 2 if steps else 0
         f_blk[len(steps):] = cur_blk
@@ -1346,7 +1501,8 @@ def _attach_fused(plan: BinnedPlan) -> BinnedPlan:
         f_blk2=jnp.asarray(f_blk2),
         f_obi=jnp.asarray(f_obi),
         f_dsrc=jnp.asarray(f_dsrc),
-        f_ddst=jnp.asarray(f_ddst))
+        f_ddst=jnp.asarray(f_ddst),
+        f_last=jnp.asarray(f_last))
 
 
 # ---------------------------------------------------------------------------
@@ -1824,6 +1980,248 @@ def _fused_vmem_ok(geom: Geometry, Hp: int, c2: int) -> bool:
             + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
             + 2 * geom.sb * Hp * 4 + geom.rb * Hp * 4)
     return need <= _VMEM_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# Whole-layer megakernel: aggregate -> linear (-> ReLU) in the SAME fused
+# grid (round 10, docs/DESIGN.md §Megakernel).  Each phase-2 step's [RB, H]
+# aggregation tile stays in registers/VMEM and feeds the MXU weight matmul
+# directly; only the post-linear (optionally post-ReLU) [RB, H_out] window
+# ever reaches HBM — the [rows, H_in] aggregate never materializes.
+# ---------------------------------------------------------------------------
+
+def _mega_kernel(blk_ref, blk2_ref, obi_ref, last_ref, meta_ref, dsrc_ref,
+                 ddst_ref, rows_ref, x_ref, x2_ref, w_ref, out_ref, gbuf,
+                 stgbuf, sems, *, exact: bool = False,
+                 geom: Geometry = None, relu: bool = False):
+    """_fused_kernel with the layer's W matmul grafted onto every phase-2
+    step.  Kind 0 (phase 1) is byte-identical to the fused kernel; kind 1
+    scatter-adds one staging chunk into a per-chunk [RB, H] aggregate
+    tile, then accumulates tile @ W into the resident [RB, H_out] out
+    window (fp32, `highest` — the ops.linear fp32 contract).  Correct per
+    chunk because matmul distributes over the bin's chunk sum:
+    sum_c(tile_c) @ W == sum_c(tile_c @ W) exactly on fp32 adds of the
+    same addends.  The optional ReLU applies on the bin's LAST real chunk
+    (f_last; the out index is nondecreasing so the window is still
+    resident) — pad-step revisits add exact zeros, which commute with it.
+    The weight rides a constant-index BlockSpec: fetched into VMEM once
+    and double-buffer-stable across the whole grid (the index map never
+    changes, so pallas never refetches it alongside the parity staging).
+    """
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    U = geom.unit_rows
+    st = staging_dtype(geom, exact)
+    c = pl.program_id(0)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+        sl = rows_ref[:]
+        t1 = (lane == sl).astype(jnp.bfloat16)
+        gbuf[:] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())),
+                              exact).astype(st)
+
+        @pl.when(blk2_ref[c] != blk_ref[c])
+        def _():
+            t2 = (lane == sl - SB).astype(jnp.bfloat16)
+            gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                t2, x2_ref[:], (((1,), (0,)), ((), ())), exact)).astype(st)
+
+        def issue(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).start()
+            return 0
+        jax.lax.fori_loop(0, KD, issue, 0)
+
+        def drain(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).wait()
+            return 0
+        jax.lax.fori_loop(0, KD, drain, 0)
+
+    @pl.when(kind == 1)
+    def _():
+        @pl.when(first == 1)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        rows = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        s_t = (lane == dl).astype(jnp.bfloat16)
+        tile = _onehot_dot(s_t, rows, (((0,), (0,)), ((), ())), exact)
+        out_ref[:] += jax.lax.dot_general(
+            tile, w_ref[:], (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        if relu:
+            @pl.when(last_ref[c] == 1)
+            def _():
+                out_ref[:] = jnp.maximum(out_ref[:], 0.0)
+
+
+@partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
+                                   "exact", "geom", "relu"))
+def _mega_run(x, w, blk, blk2, obi, last, meta, dsrc, ddst, rows,
+              nsteps: int, c2: int, out_rows: int, interpret: bool = False,
+              exact: bool = False, geom: Geometry = None,
+              relu: bool = False):
+    H = x.shape[-1]
+    Ho = w.shape[-1]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    srows = c2 * geom.ch2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,                  # blk, blk2, obi, last [S]
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((8, 4), lambda c, b, b2, o, l: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o, l: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o, l: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, b, b2, o, l: (c, 0)),
+            pl.BlockSpec((SB, H), lambda c, b, b2, o, l: (b[c], 0)),
+            pl.BlockSpec((SB, H), lambda c, b, b2, o, l: (b2[c], 0)),
+            # whole weight, constant index: fetched once, VMEM-resident
+            pl.BlockSpec((H, Ho), lambda c, b, b2, o, l: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((RB, Ho), lambda c, b, b2, o, l: (o[c], 0)),
+        scratch_shapes=[pltpu.VMEM((CH, H), staging_dtype(geom, exact)),
+                        pltpu.VMEM((2, srows, H),
+                                   staging_dtype(geom, exact)),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    return pl.pallas_call(
+        partial(_mega_kernel, exact=exact, geom=geom, relu=relu),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_rows, Ho), jnp.float32),
+        interpret=interpret,
+    )(blk, blk2, obi, last, meta, dsrc, ddst, rows, x, x, w)
+
+
+def _mega_vmem_ok(geom: Geometry, Hp: int, Ho_p: int, c2: int) -> bool:
+    """_fused_vmem_ok extended with the megakernel's extra residents: the
+    [Hp, Ho_p] weight tile, the per-chunk [rb, Hp] aggregate tile the dot
+    produces, and the [rb, Ho_p] post-linear out window (replacing the
+    fused kernel's [rb, Hp] one).  An oversized H_out fails here and
+    run_binned_linear falls back to two-pass aggregate + XLA linear."""
+    srows = c2 * geom.ch2
+    stg = staging_itemsize(geom, False)
+    need = (2 * srows * Hp * stg + geom.ch * Hp * stg
+            + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
+            + 2 * geom.sb * Hp * 4
+            + Hp * Ho_p * 4              # resident weight tile
+            + geom.rb * Hp * 4           # per-chunk aggregate tile
+            + geom.rb * Ho_p * 4)        # post-linear out window
+    return need <= _VMEM_BUDGET
+
+
+# ROC_NO_MEGAFUSE kill switch: one warning per process — a kill this high
+# up changes the layer program (two device passes instead of one), worth
+# one notice where ROC_BINNED_NO_FUSE stays a silent bisection knob.
+_MEGA_KILL_WARNED = [False]
+
+
+def megafuse_killed() -> bool:
+    """True when ROC_NO_MEGAFUSE=1 disables aggregate->linear megakernel
+    fusion at runtime (checked at every dispatch site; warn-once)."""
+    if not os.environ.get("ROC_NO_MEGAFUSE"):
+        return False
+    if not _MEGA_KILL_WARNED[0]:
+        _MEGA_KILL_WARNED[0] = True
+        warnings.warn(
+            "ROC_NO_MEGAFUSE=1: aggregate->linear megakernel fusion "
+            "disabled; eligible layers run the two-pass aggregation plus "
+            "the XLA linear instead.", stacklevel=2)
+    return True
+
+
+def run_binned_linear(x, w, plan: BinnedPlan, interpret: bool = False,
+                      precision: str = "fast", activation: str = "none"):
+    """linear(aggregate-sum(x), w)[, ReLU] in ONE Pallas grid — the
+    whole-layer megakernel (round 10).
+
+    x: [table_rows, H_in], w: [H_in, H_out] -> [num_rows, H_out] in
+    x.dtype.  Semantics match run_binned followed by ops.linear (fp32
+    accumulation, `highest`-precision matmul); on the megakernel path
+    the [num_rows, H_in] aggregate never reaches HBM.  Gating mirrors
+    run_binned's fused gate plus the weight/accumulator VMEM budget
+    (_mega_vmem_ok) and the ROC_NO_MEGAFUSE kill switch; any gate
+    failure falls back to exactly that two-pass composition, so callers
+    always get the layer, just not always in one kernel.  Differentiable
+    through the fallback only — training uses the custom VJP in
+    ops.aggregate.scatter_gather_linear_binned, whose backward replays
+    the two-pass path."""
+    if activation not in ("none", "relu"):
+        raise ValueError(f"activation={activation!r}: the megakernel "
+                         f"fuses 'none' or 'relu' only")
+    if precision not in ("fast", "exact"):
+        raise ValueError(f"precision={precision!r}: must be 'fast' or "
+                         f"'exact'")
+    exact = precision == "exact" and x.dtype == jnp.float32
+    geom = plan.geom or _default_geom()
+    H = x.shape[-1]
+    Ho = w.shape[-1]
+    Hp = _pad_to(H, 128)
+    Ho_p = _pad_to(Ho, 128)
+    C2 = plan.p2_obi.shape[1]
+    if (geom.flat and plan.f_meta is not None
+            and plan.f_last is not None
+            and not (exact and geom.unit == 16)
+            and not os.environ.get("ROC_BINNED_NO_FUSE")
+            and not megafuse_killed()
+            and _mega_vmem_ok(geom, Hp, Ho_p, C2)):
+        G = plan.p1_blk.shape[0]
+        out_rows = G * plan.bins_per_group * geom.rb
+        xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, geom.sb)
+                          - x.shape[0]), (0, Hp - H)))
+        # fp32 weight, zero-padded to whole lanes on both axes: pad H_in
+        # rows multiply x's zero pad lanes, pad H_out lanes are stripped
+        wp = jnp.pad(w.astype(jnp.float32),
+                     ((0, Hp - H), (0, Ho_p - Ho)))
+        S = int(plan.f_blk.shape[0])
+        with jax.named_scope("roc_binned_mega"):
+            out = _mega_run(xp, wp, plan.f_blk, plan.f_blk2, plan.f_obi,
+                            plan.f_last, plan.f_meta, plan.f_dsrc,
+                            plan.f_ddst, plan.f_rows, S, C2, out_rows,
+                            interpret, exact, geom,
+                            activation == "relu")
+        return out[:plan.num_rows, :Ho].astype(x.dtype)
+    # VMEM-gate / kill-switch fallback: the identical two-pass layer
+    from roc_tpu.ops.linear import linear
+    return linear(run_binned(x, plan, interpret, precision), w, activation)
 
 
 # one-shot: the eager path is a silent ~9x dispatch-overhead footgun

@@ -89,6 +89,16 @@ class ShardedGraphData:
                                        metadata={"static": True})
     xch_comp: str = dataclasses.field(default="plain",
                                       metadata={"static": True})
+    # Whole-layer megakernel mode (config.megafuse).  Static for the same
+    # reason as xch_dtype: flipping it changes tree_structure(gd), so the
+    # step cache re-traces instead of serving the other mode's program.
+    # Sharded steps currently never run the fused kernel itself —
+    # pad_binned_plans strips the f_* schedule at shard stacking, so every
+    # GraphCtx here keeps fuse_linear=None and the unfused sequence runs;
+    # the field exists so the cache signature is honest the day a sharded
+    # fused path lands, and so mode flips are provably retraces today.
+    megafuse: bool = dataclasses.field(default=False,
+                                       metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
@@ -97,7 +107,7 @@ jax.tree_util.register_dataclass(
                  "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans",
                  "plans_local", "plans_remote"],
     meta_fields=["backend", "mode", "precision", "xch_dtype", "xch_round",
-                 "xch_comp"])
+                 "xch_comp", "megafuse"])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -616,7 +626,8 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
                 precision: str = "exact",
                 gat_backend: str = "xla",
                 halo_overlap: bool = False,
-                xch: tuple = ("fp32", "nearest", "plain")) -> ShardedGraphData:
+                xch: tuple = ("fp32", "nearest", "plain"),
+                megafuse: bool = False) -> ShardedGraphData:
     if halo is not None:
         src = halo.edge_src_local
     else:
@@ -651,6 +662,7 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         backend=backend,
         precision=precision,
         xch_dtype=xch[0], xch_round=xch[1], xch_comp=xch[2],
+        megafuse=megafuse,
     )
 
 
@@ -1279,7 +1291,8 @@ class SpmdTrainer(BaseTrainer):
                 in_degree=jnp.asarray(self.part.in_degree, jnp.float32),
                 send_idx=None, plans=plans, gat_plans=gat_plans,
                 backend=backend, mode="edge",
-                precision=cfg.aggregate_precision)
+                precision=cfg.aggregate_precision,
+                megafuse=cfg.megafuse)
         if self._exchange_mode == "ring":
             from roc_tpu.parallel.ring import build_ring_groups, \
                 build_ring_plans
@@ -1299,7 +1312,8 @@ class SpmdTrainer(BaseTrainer):
                 ring_dst=jnp.asarray(rm.ring_dst),
                 plans=None, ring_plans=ring_plans, backend=backend,
                 mode="ring", precision=cfg.aggregate_precision,
-                xch_dtype=xd, xch_round=xr, xch_comp=xc)
+                xch_dtype=xd, xch_round=xr, xch_comp=xc,
+                megafuse=cfg.megafuse)
         if self._exchange_mode == "halo":
             with obs.span("halo_build", parts=self.part.num_parts):
                 self.halo = build_halo_maps(self.part)
@@ -1325,7 +1339,8 @@ class SpmdTrainer(BaseTrainer):
                                cfg.aggregate_precision,
                                gat_backend=gat_backend,
                                halo_overlap=self._halo_overlap(),
-                               xch=self._xch_meta())
+                               xch=self._xch_meta(),
+                               megafuse=cfg.megafuse)
 
     def _build_graph_perhost(self, backend: str,
                              gat_backend: str = "xla") -> ShardedGraphData:
@@ -1397,7 +1412,8 @@ class SpmdTrainer(BaseTrainer):
                     jnp.float32),
                 send_idx=None, plans=plans, gat_plans=gat_plans,
                 backend=backend, mode="edge",
-                precision=cfg.aggregate_precision)
+                precision=cfg.aggregate_precision,
+                megafuse=cfg.megafuse)
         local = shard_load.load_local_shards(path, meta, part_ids)
         if self._exchange_mode == "ring":
             # Ring × perhost (closes a round-3 documented fallback): every
@@ -1426,7 +1442,8 @@ class SpmdTrainer(BaseTrainer):
                 ring_dst=jnp.asarray(rm.ring_dst),
                 plans=None, ring_plans=ring_plans, backend=backend,
                 mode="ring", precision=cfg.aggregate_precision,
-                xch_dtype=xd, xch_round=xr, xch_comp=xc)
+                xch_dtype=xd, xch_round=xr, xch_comp=xc,
+                megafuse=cfg.megafuse)
         lhalo = shard_load.build_halo_local(meta, local, ag) \
             if self._exchange_mode == "halo" else None
         self.halo = lhalo
@@ -1466,7 +1483,8 @@ class SpmdTrainer(BaseTrainer):
             plans_remote=plans_remote,
             backend=backend,
             precision=cfg.aggregate_precision,
-            xch_dtype=xd, xch_round=xr, xch_comp=xc)
+            xch_dtype=xd, xch_round=xr, xch_comp=xc,
+            megafuse=cfg.megafuse)
 
     def _place_parts(self, gd: ShardedGraphData,
                      spec: NamedSharding) -> ShardedGraphData:

@@ -91,6 +91,14 @@ class Config:
     bf16_exchange: str = "plain"  # plain: one bf16 term (half the bytes) |
                                   # compensated: (hi, lo) bf16 pair — fp32
                                   # bytes, parity control for the pipeline
+    megafuse: bool = False        # whole-layer megakernel: fuse each
+                                  # aggregate->linear(->relu) pair into one
+                                  # Pallas grid on the binned-flat backend
+                                  # (ops/pallas/binned.py run_binned_linear)
+                                  # — the [rows, H_in] aggregate never
+                                  # reaches HBM.  Opt-in; off keeps every
+                                  # program byte-identical.  Runtime kill
+                                  # switch: ROC_NO_MEGAFUSE=1
     lazy_load: bool = False       # memmap features / defer one-hot labels
                                   # (sharded host loading for huge graphs)
     halo: bool = True             # v1 halo exchange vs v0 all_gather
@@ -202,6 +210,11 @@ class Config:
         if self.bf16_exchange not in ("plain", "compensated"):
             raise SystemExit(f"bad bf16_exchange {self.bf16_exchange!r} "
                              "(plain|compensated)")
+        # ROC_MEGAFUSE mirrors -megafuse for driverless entry points
+        # (bench.py, hw_revalidate mega A/B legs); ROC_NO_MEGAFUSE stays a
+        # runtime kill switch checked at dispatch, not a config field.
+        if env.get("ROC_MEGAFUSE"):
+            self.megafuse = env["ROC_MEGAFUSE"] == "1"
         if self.bf16_storage and self.aggregate_precision == "exact":
             # the binned flat bf16 unit and the bf16 wire both round where
             # "exact" promises fp32 end to end — refuse the contradiction
@@ -284,6 +297,9 @@ def parse_args(argv: List[str]) -> Config:
                    default="nearest", choices=["nearest", "stochastic"])
     p.add_argument("-bf16-exchange", dest="bf16_exchange",
                    default="plain", choices=["plain", "compensated"])
+    p.add_argument("-megafuse", dest="megafuse", action="store_true",
+                   help="fuse aggregate->linear(->relu) layers into one "
+                        "Pallas megakernel (binned-flat backend)")
     p.add_argument("-lazy", dest="lazy_load", action="store_true")
     p.add_argument("-no-halo", dest="halo", action="store_false")
     p.add_argument("-no-halo-overlap", dest="halo_overlap",

@@ -78,7 +78,8 @@ AUTO_BINNED = True
 
 def resolve_backend_geom(backend: str, num_edges: int, num_rows: int = 0,
                          table_rows: int = 0, edge_src=None, edge_dst=None,
-                         storage_dtype: str = "fp32"):
+                         storage_dtype: str = "fp32",
+                         fuse_linear: bool = False):
     """Resolve the aggregation backend; returns (backend, geometry).
 
     With edge arrays provided, the binned-vs-matmul call uses ACTUAL cell
@@ -87,7 +88,12 @@ def resolve_backend_geom(backend: str, num_edges: int, num_rows: int = 0,
     a locality-preserving vertex order is credited for the cells it never
     touches, which is what gives products-density graphs a binned path.
     The chosen forward-direction Geometry rides back so the plan build
-    doesn't redo the O(E) statistics (None when no choice was made)."""
+    doesn't redo the O(E) statistics (None when no choice was made).
+
+    ``fuse_linear`` (the -megafuse path) prices every candidate for the
+    aggregate->linear layer handoff: non-mega-eligible schedules pay the
+    intermediate's HBM round trip, so a flat geometry the megakernel can
+    consume wins wherever its schedule is within that credit."""
     if backend == "auto":
         on_tpu = jax.default_backend() == "tpu"
         if not (on_tpu and num_edges >= AUTO_MATMUL_EDGES):
@@ -97,7 +103,8 @@ def resolve_backend_geom(backend: str, num_edges: int, num_rows: int = 0,
             if edge_src is not None:
                 g, _ = choose_geometry(edge_src, edge_dst, num_rows,
                                        table_rows,
-                                       storage_dtype=storage_dtype)
+                                       storage_dtype=storage_dtype,
+                                       fuse_linear=fuse_linear)
                 if g is not None:
                     return "binned", g
             elif binned_viable(num_rows, table_rows, num_edges):
@@ -132,10 +139,12 @@ def resolve_gat_backend(backend: str, num_edges: int) -> str:
 def dense_graph_data(graph, backend: str = "xla",
                      precision: str = "exact",
                      gat_backend: str = "xla",
-                     storage_dtype: str = "fp32") -> DenseGraphData:
+                     storage_dtype: str = "fp32",
+                     megafuse: bool = False) -> DenseGraphData:
     backend, geom = resolve_backend_geom(
         backend, graph.num_edges, graph.num_nodes, graph.num_nodes,
-        graph.col_idx, graph.dst_idx, storage_dtype=storage_dtype)
+        graph.col_idx, graph.dst_idx, storage_dtype=storage_dtype,
+        fuse_linear=megafuse)
     plans = None
     with obs.span("plan_build", backend=backend):
         if backend == "matmul":
@@ -148,7 +157,7 @@ def dense_graph_data(graph, backend: str = "xla",
             plans = ops.build_binned_plans(
                 graph.col_idx, graph.dst_idx, graph.num_nodes,
                 graph.num_nodes, geom=(geom or "auto", "auto"),
-                storage_dtype=storage_dtype)
+                storage_dtype=storage_dtype, fuse_linear=megafuse)
         gat_plans = None
         if gat_backend == "plan":
             from roc_tpu.ops.edge import build_gat_plans
@@ -165,7 +174,8 @@ def dense_graph_data(graph, backend: str = "xla",
     )
 
 
-def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
+def make_gctx(g: DenseGraphData, num_nodes: int,
+              megafuse: bool = False) -> GraphCtx:
     interp = pallas_interpret()
 
     def aggregate(x, aggr):
@@ -194,8 +204,42 @@ def make_gctx(g: DenseGraphData, num_nodes: int) -> GraphCtx:
         return ops.gat_attend(h, h, g.edge_src, g.edge_dst, num_nodes,
                               a_src, a_dst, slope)
 
+    fuse_linear = None
+    if megafuse and g.backend == "binned" and g.plans is not None \
+            and g.plans.mm is None:
+        from roc_tpu.ops.pallas import binned as _B
+
+        def fuse_linear(x, w, activation, aggr):
+            # Trace-time legality, all static: a None return makes
+            # model.apply run that layer's byte-identical unfused op
+            # sequence instead (hybrid plans were excluded above — their
+            # matmul side adds outside any kernel).
+            plan = g.plans.fwd
+            geom = plan.geom
+            exact = g.precision == "exact" and x.dtype == jnp.float32
+            if (geom is None or not geom.flat or plan.f_meta is None
+                    or plan.f_last is None
+                    or (exact and geom.unit == 16)
+                    or os.environ.get("ROC_BINNED_NO_FUSE")
+                    or _B.megafuse_killed()
+                    or not _B._mega_vmem_ok(
+                        geom, _B._pad_to(x.shape[-1], 128),
+                        _B._pad_to(w.shape[-1], 128),
+                        plan.p2_obi.shape[1])):
+                return None
+            out = ops.scatter_gather_linear_binned(
+                x, w, g.plans, interp, g.precision,
+                "none" if aggr == "avg" else activation)
+            if aggr == "avg":
+                # (D^-1 A) W == D^-1 (A W), and relu commutes with the
+                # positive diagonal scale — divide + activate after the
+                # sum-aggregating kernel
+                out = ops.divide_by_degree(out, g.in_degree)
+                out = ops.apply_activation(out, activation)
+            return out
+
     return GraphCtx(aggregate=aggregate, in_degree=g.in_degree,
-                    attend=attend)
+                    attend=attend, fuse_linear=fuse_linear)
 
 
 @dataclasses.dataclass
@@ -600,7 +644,8 @@ class Trainer(BaseTrainer):
         self.gdata = dense_graph_data(
             ds.graph, backend, self.config.aggregate_precision,
             gat_backend=self._gat_backend(),
-            storage_dtype="bf16" if self.config.bf16_storage else "fp32")
+            storage_dtype="bf16" if self.config.bf16_storage else "fp32",
+            megafuse=self.config.megafuse)
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.onehot_labels(), jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)
@@ -610,6 +655,7 @@ class Trainer(BaseTrainer):
         n = self.num_nodes
         self._resolve_mem_plan()
         loss_fn = self._loss_fn()
+        mega = self.config.megafuse
         obs_on = self.config.obs
         if obs_on:
             from roc_tpu.obs import channel as obs_channel
@@ -617,7 +663,7 @@ class Trainer(BaseTrainer):
         @jax.jit
         def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
             _retrace.note_trace("train_step")
-            gctx = make_gctx(gdata, n)
+            gctx = make_gctx(gdata, n, mega)
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, x, labels, mask, gctx, key=key, train=True)
             params, opt_state = self.optimizer.update(
@@ -638,14 +684,15 @@ class Trainer(BaseTrainer):
         @jax.jit
         def eval_step(params, x, labels, mask, gdata):
             _retrace.note_trace("eval_step")
-            gctx = make_gctx(gdata, n)
+            gctx = make_gctx(gdata, n, mega)
             logits = model.apply(params, x, gctx, train=False)
             return ops.perf_metrics(logits, labels, mask)
 
         @jax.jit
         def logits_step(params, x, gdata):
             _retrace.note_trace("logits_step")
-            return model.apply(params, x, make_gctx(gdata, n), train=False)
+            return model.apply(params, x, make_gctx(gdata, n, mega),
+                               train=False)
 
         self._train_step = train_step
         self._eval_step = eval_step

@@ -219,16 +219,25 @@ spec_col = pl.BlockSpec((512, 1), lambda i: (i, 0))      # (N, 1): exempt
 spec_smem = pl.BlockSpec((8, 4), lambda i: (i, 0),
                          memory_space=pltpu.SMEM)        # SMEM: exempt
 spec_dyn = pl.BlockSpec((n, h), lambda i: (i, 0))        # unresolvable
+
+# megakernel epilogue tiles (round 10): the weight / fused-output lane
+# dim must be the 128-padded H_out — a raw H_out lane is exactly the
+# bug class _mega_kernel's BlockSpecs must avoid
+HOP = 128
+mega_w_bad = pl.BlockSpec((128, 41), lambda i: (0, i))   # raw H_out: flag
+mega_w_ok = pl.BlockSpec((128, HOP), lambda i: (0, i))   # padded: clean
+mega_acc_ok = pl.BlockSpec((256, HOP), lambda i: (i, 0))
 """
 
 
 def test_mosaic_lint_flags_fixture():
     from roc_tpu.analysis import mosaic
     fs = mosaic.lint_source(_MOSAIC_FIXTURE, "<fixture>")
-    assert len(fs) == 3, fs
+    assert len(fs) == 4, fs
     assert all(f.rule == "mosaic-align" for f in fs)
     lines = sorted(f.line for f in fs)
-    assert lines == [8, 13, 14], fs   # the ds(0,41) + two bad BlockSpecs
+    # the ds(0,41), two bad BlockSpecs, and the raw-H_out mega weight tile
+    assert lines == [8, 13, 14, 25], fs
 
 
 def test_mosaic_lint_waiver():
@@ -236,7 +245,7 @@ def test_mosaic_lint_waiver():
     src = _MOSAIC_FIXTURE.replace(
         "# sublane 41 % 8 != 0: flag", "# roclint: allow(mosaic-align)")
     fs = mosaic.lint_source(src, "<fixture>")
-    assert len(fs) == 2 and all(f.line > 8 for f in fs), fs
+    assert len(fs) == 3 and all(f.line > 8 for f in fs), fs
 
 
 def test_mosaic_lint_clean_on_tree():

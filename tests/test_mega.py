@@ -1,0 +1,397 @@
+"""Whole-layer megakernel: aggregate->linear(->activation) in one Pallas
+grid (ops/pallas/binned.py run_binned_linear + the model executor's
+mega_matches dispatch), in interpret mode on CPU.
+
+Bit-equality tests use INTEGER-valued features, weights, and cotangents
+(same convention as tests/test_binned_flat.py): small integers survive
+bf16 rounding and fp32 summation exactly, so the fused kernel's different
+fp32 add order still produces bit-identical sums, and the `highest`
+precision matmul both paths share is exact on them.  The backward tests
+are bitwise BY CONSTRUCTION: scatter_gather_linear_binned's custom VJP
+replays the unfused two-pass composition, so its gradients are literally
+the same program — the tests pin that contract.
+
+Relu caveat (documented, not a bug): with avg aggregation the fused op
+runs activation-free and divides/activates outside, so pre-activations
+that land exactly on 0.0 can flip the relu gate between reassociation
+orders on CONTINUOUS data.  Sum aggregation (GIN) is the bitwise lane.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu import ops
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gcn, build_gin, build_sage
+from roc_tpu.models.model import mega_matches
+from roc_tpu.ops.pallas import binned as B
+from roc_tpu.train.config import Config, parse_args
+from roc_tpu.train.driver import Trainer, dense_graph_data, make_gctx
+
+# Small flat geometries for CPU interpret runs (same shapes as
+# tests/test_binned_flat.py): fp32 8-row units and bf16 16-row units.
+GF = B.Geometry(sb=256, ch=512, slot=128, rb=256, ch2=512, grt=1 << 14,
+                flat=1)
+GFB = GF._replace(unit=16)
+
+BASE = dict(num_epochs=3, learning_rate=0.01, weight_decay=5e-4,
+            dropout_rate=0.0, eval_every=1000)
+
+
+def _int_graph(n, t, e, h, seed):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, t, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    if e > 100:
+        dst[: e // 4] = 7       # hub destination spanning many chunks
+    x = rng.integers(-4, 5, (t, h)).astype(np.float32)
+    return src, dst, x
+
+
+def _int_w(h, ho, seed):
+    return np.random.default_rng(seed).integers(-3, 4, (h, ho)) \
+        .astype(np.float32)
+
+
+def _spy_mega_run(monkeypatch):
+    """Count real megakernel launches so fallback can't fake a pass."""
+    calls = []
+    orig = B._mega_run
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(B, "_mega_run", spy)
+    return calls
+
+
+# -- op-graph pattern matcher ---------------------------------------------
+
+def test_mega_matches_gin_sage_gcn():
+    """GIN (aggregate->linear+relu) and SAGE (aggregate->linear) match;
+    GCN does not (its aggregate feeds a norm, not a linear)."""
+    gin = mega_matches(build_gin([16, 8, 4], 0.5))
+    assert len(gin) == 2
+    for rec in gin.values():
+        assert rec["aggregate"].kind == "aggregate"
+        assert rec["linear"].kind == "linear"
+        assert rec["activation"] == "relu"   # the linear's own epilogue
+        assert rec["final"] is rec["linear"]
+        assert rec["skip"]                   # ops the fused op buys out
+    sage = mega_matches(build_sage([16, 8, 4], 0.5))
+    assert len(sage) == 2
+    assert all(r["activation"] == "none" for r in sage.values())
+    assert mega_matches(build_gcn([16, 8, 4], 0.5)) == {}
+
+
+# -- fused kernel vs two-pass composition ---------------------------------
+
+@pytest.mark.parametrize("geom", [GF, GFB], ids=["fp32unit", "bf16unit"])
+@pytest.mark.parametrize("act", ["none", "relu"])
+def test_mega_fwd_bitwise_vs_twopass(geom, act, monkeypatch):
+    """run_binned_linear on the megakernel path must be BIT-identical to
+    linear(run_binned(x), w) on integer data, at both staging units and
+    with the fused relu, including lane-unaligned H_out."""
+    n, t, e, h, ho = 700, 700, 5000, 64, 41
+    src, dst, x = _int_graph(n, t, e, h, 3)
+    w = _int_w(h, ho, 4)
+    plan = B.build_binned_plan(src, dst, n, t, geom=geom)
+    assert plan.f_meta is not None and plan.f_last is not None
+    assert B._mega_vmem_ok(geom, 128, 128, plan.p2_obi.shape[1])
+    calls = _spy_mega_run(monkeypatch)
+    out = np.asarray(B.run_binned_linear(jnp.asarray(x), jnp.asarray(w),
+                                         plan, interpret=True,
+                                         activation=act))
+    assert calls, "megakernel fell back to two-pass"
+    agg = B.run_binned(jnp.asarray(x), plan, interpret=True)
+    ref = np.asarray(ops.linear(agg, jnp.asarray(w), act))
+    np.testing.assert_array_equal(out, ref)
+    oracle = np.zeros((n, h), np.float32)
+    np.add.at(oracle, dst, x[src])
+    oracle = oracle @ w
+    if act == "relu":
+        oracle = np.maximum(oracle, 0)
+    np.testing.assert_array_equal(out, oracle)
+
+
+def test_mega_grad_bitwise_vs_unfused():
+    """The custom VJP replays the unfused two-pass composition, so
+    gradients of the fused layer are bitwise those of
+    linear(scatter_gather_binned(x), w) — pinned on integer data with the
+    fused relu active."""
+    n, e, h, ho = 700, 5000, 32, 16
+    src, dst, x = _int_graph(n, n, e, h, 7)
+    w = _int_w(h, ho, 8)
+    g = np.random.default_rng(9).integers(-3, 4, (n, ho)).astype(np.float32)
+    plans = ops.build_binned_plans(src, dst, n, n, geom=GF)
+    y_f, vjp_f = jax.vjp(
+        lambda xx, ww: ops.scatter_gather_linear_binned(
+            xx, ww, plans, True, "fast", "relu"),
+        jnp.asarray(x), jnp.asarray(w))
+    y_u, vjp_u = jax.vjp(
+        lambda xx, ww: ops.linear(
+            ops.scatter_gather_binned(xx, plans, True), ww, "relu"),
+        jnp.asarray(x), jnp.asarray(w))
+    np.testing.assert_array_equal(np.asarray(y_f), np.asarray(y_u))
+    gx_f, gw_f = vjp_f(jnp.asarray(g))
+    gx_u, gw_u = vjp_u(jnp.asarray(g))
+    np.testing.assert_array_equal(np.asarray(gx_f), np.asarray(gx_u))
+    np.testing.assert_array_equal(np.asarray(gw_f), np.asarray(gw_u))
+
+
+def test_mega_vmem_gate_rejects_oversized_hout(monkeypatch):
+    """An H_out whose weight tile + output block cannot fit the VMEM
+    budget must fall back to the two-pass composition cleanly — same
+    numbers, zero megakernel launches."""
+    n, t, e, h, ho = 300, 300, 2000, 16, 16384
+    src, dst, x = _int_graph(n, t, e, h, 11)
+    w = _int_w(h, ho, 12)
+    plan = B.build_binned_plan(src, dst, n, t, geom=GF)
+    assert plan.f_meta is not None     # fused schedule exists...
+    assert not B._mega_vmem_ok(GF, 128, B._pad_to(ho, 128),
+                               plan.p2_obi.shape[1])   # ...but won't fit
+    calls = _spy_mega_run(monkeypatch)
+    out = np.asarray(B.run_binned_linear(jnp.asarray(x), jnp.asarray(w),
+                                         plan, interpret=True))
+    assert not calls
+    ref = np.asarray(ops.linear(B.run_binned(jnp.asarray(x), plan,
+                                             interpret=True),
+                                jnp.asarray(w)))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_mega_rejects_bad_activation_and_hybrid():
+    src = np.array([0, 1], np.int64)
+    dst = np.array([1, 0], np.int64)
+    plan = B.build_binned_plan(src, dst, 32, 32, geom=GF)
+    x, w = jnp.ones((32, 16)), jnp.ones((16, 8))
+    with pytest.raises(ValueError, match="activation"):
+        B.run_binned_linear(x, w, plan, interpret=True,
+                            activation="sigmoid")
+    plans = ops.build_binned_plans(src, dst, 32, 32, geom=GF)
+    hybrid = plans._replace(mm=(jnp.zeros(1),))   # any non-None pytree
+    with pytest.raises(AssertionError, match="hybrid"):
+        ops.scatter_gather_linear_binned(x, w, hybrid, True)
+
+
+# -- kill switch + config knob --------------------------------------------
+
+def test_megafuse_kill_switch_warns_once_and_falls_back(monkeypatch):
+    monkeypatch.setattr(B, "_MEGA_KILL_WARNED", [False])
+    monkeypatch.setenv("ROC_NO_MEGAFUSE", "1")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert B.megafuse_killed()
+        assert B.megafuse_killed()
+    assert sum("ROC_NO_MEGAFUSE" in str(r.message) for r in rec) == 1
+    n, t, e, h = 300, 300, 2000, 16
+    src, dst, x = _int_graph(n, t, e, h, 13)
+    w = _int_w(h, 8, 14)
+    plan = B.build_binned_plan(src, dst, n, t, geom=GF)
+    calls = _spy_mega_run(monkeypatch)
+    out = np.asarray(B.run_binned_linear(jnp.asarray(x), jnp.asarray(w),
+                                         plan, interpret=True))
+    assert not calls
+    ref = np.asarray(ops.linear(B.run_binned(jnp.asarray(x), plan,
+                                             interpret=True),
+                                jnp.asarray(w)))
+    np.testing.assert_array_equal(out, ref)
+    monkeypatch.delenv("ROC_NO_MEGAFUSE")
+    monkeypatch.setattr(B, "_MEGA_KILL_WARNED", [False])
+    assert not B.megafuse_killed()
+
+
+def test_config_megafuse_knobs(monkeypatch):
+    assert Config().megafuse is False
+    assert parse_args(["-megafuse"]).megafuse is True
+    monkeypatch.setenv("ROC_MEGAFUSE", "1")
+    assert Config().megafuse is True
+    monkeypatch.setenv("ROC_MEGAFUSE", "0")
+    assert Config().megafuse is False
+    monkeypatch.delenv("ROC_MEGAFUSE")
+
+
+# -- model executor dispatch ----------------------------------------------
+
+def _mega_ds():
+    return datasets.get("mega-shard", seed=1)
+
+
+def test_model_fuse_hook_none_is_byte_identical():
+    """A fuse hook that declines every layer must reproduce the default
+    executor bitwise — the hook only ever REPLACES the unfused sequence,
+    never alters it."""
+    ds = _mega_ds()
+    model = build_gin([ds.in_dim, 16, ds.num_classes], 0.0)
+    params = model.init_params(jax.random.PRNGKey(0))
+    gdata = dense_graph_data(ds.graph)
+    x = jnp.asarray(ds.features)
+    gctx = make_gctx(gdata, ds.graph.num_nodes)
+    declined = gctx._replace(fuse_linear=lambda *a: None)
+    a = model.apply(params, x, gctx, train=False)
+    b = model.apply(params, x, declined, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_driver_megafuse_executes_and_matches(monkeypatch):
+    """End-to-end A/B at the mega-shard shape, flat geometry pinned on
+    both legs (hw_revalidate step 4c's CPU twin): the -megafuse leg must
+    launch the real megakernel and finish with BIT-identical logits."""
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    ds = _mega_ds()
+    layers = [ds.in_dim, 16, ds.num_classes]
+    logits = {}
+    for mf in (False, True):
+        cfg = Config(layers=layers, **BASE, aggregate_backend="binned",
+                     megafuse=mf)
+        tr = Trainer(cfg, ds, build_gin(layers, 0.0))
+        assert tr.gdata.plans.fwd.geom.flat == 1
+        calls = _spy_mega_run(monkeypatch)
+        tr.train(print_fn=lambda *a, **k: None)
+        assert bool(calls) == mf
+        logits[mf] = np.asarray(tr._logits_step(tr.params, tr.x, tr.gdata))
+    np.testing.assert_array_equal(logits[True], logits[False])
+
+
+def test_zero_retraces_with_megafuse(monkeypatch):
+    """Steady-state retrace proof with the megakernel active: epochs 2..N
+    re-enter the same jitted step (fusion is trace-time static — nothing
+    about it varies per step)."""
+    from roc_tpu.analysis.retrace import RetraceGuard
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    ds = _mega_ds()
+    layers = [ds.in_dim, 16, ds.num_classes]
+    cfg = Config(layers=layers, **BASE, aggregate_backend="binned",
+                 megafuse=True)
+    tr = Trainer(cfg, ds, build_gin(layers, 0.0))
+    with RetraceGuard(warmup=1) as g:
+        tr.train(print_fn=lambda *a, **k: None)
+        assert g.counts["train_step"] >= 1
+
+
+def test_sharded_step_cache_keys_on_megafuse():
+    """megafuse rides ShardedGraphData as STATIC metadata (like
+    xch_dtype): flipping it changes tree_structure(gd), so the step cache
+    can never serve a program traced for the other mode."""
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    ds = _mega_ds()
+    layers = [ds.in_dim, 8, ds.num_classes]
+    t_off = SpmdTrainer(Config(layers=layers, **BASE, num_parts=4,
+                               halo=True), ds, build_gcn(layers, 0.0))
+    t_on = SpmdTrainer(Config(layers=layers, **BASE, num_parts=4,
+                              halo=True, megafuse=True),
+                       ds, build_gcn(layers, 0.0))
+    assert t_on.gdata.megafuse is True and t_off.gdata.megafuse is False
+    assert jax.tree_util.tree_structure(t_on.gdata) != \
+        jax.tree_util.tree_structure(t_off.gdata)
+
+
+# -- predictors + budget pins ---------------------------------------------
+
+def test_fused_plan_steps_match_built_plan():
+    """The offline step predictor must equal the BUILT fused schedule's
+    grid size, and its C2 the plan's phase-2 chunk count — the arithmetic
+    the kernel-budget mega row trusts."""
+    n, t, e, h = 1500, 2000, 30000, 64
+    src, dst, _ = _int_graph(n, t, e, h, 21)
+    plan = B.build_binned_plan(src, dst, n, t, geom=GF)
+    assert plan.f_meta is not None
+    cb, cn, cnt = B._cell_stats(src, dst, GF.sb, GF.rb)
+    steps, c2 = B._fused_sched_stats(cb, cn, cnt, GF, n, t, e)
+    assert steps == int(plan.f_blk.shape[0])
+    assert c2 == int(plan.p2_obi.shape[1])
+    assert B.fused_plan_steps(cb, cn, cnt, GF, n, t, e) == steps
+
+
+def test_mega_hbm_drop_pin():
+    """Acceptance pin: at the Reddit GCN shape the fused layer's
+    predicted HBM traffic drops by >= the intermediate's write + read
+    (one full [rows, H_in] fp32 round trip), matching the committed
+    kernel-budget entry."""
+    import json
+    import os
+    n, h = 32768, 256
+    unfused = B.predicted_layer_hbm_bytes(n, h, h)
+    mega = B.predicted_layer_hbm_bytes(n, h, h, mega=True)
+    assert unfused - mega >= 2 * n * h * 4
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "kernel_budgets.json")
+    entry = json.load(open(path))["reddit_scaled"]["megakernel"]
+    assert entry["hbm_layer_bytes_unfused"] == unfused
+    assert entry["hbm_layer_bytes_mega"] == mega
+
+
+def test_mega_budget_row_ratio():
+    """The committed mega_shard_scaled row must keep the megakernel at
+    <= 0.85x the two-pass layer's steps (the preflight gate's claim),
+    and stay executable: the bf16-staged kernel passes the VMEM gate at
+    H=128."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "kernel_budgets.json")
+    m = json.load(open(path))["mega_shard_scaled"]["megakernel"]
+    for gname in ("flat", "flat_bf16"):
+        row = m[gname]
+        assert row["attaches"]
+        assert row["mega_steps"] <= 0.85 * row["twopass_layer_steps"]
+    assert m["flat_bf16"]["vmem_ok_h128"]
+
+
+# -- memory estimator -----------------------------------------------------
+
+def test_estimator_megafuse_drops_intermediate_bytes():
+    """Fused layers stop materializing the aggregate (and the pre-relu
+    linear out where the relu folds), so their bytes_full must shrink by
+    exactly those tensors; GCN (no match) must be unchanged."""
+    from roc_tpu.memory.estimator import estimate_model
+    rows, edges = 4096, 32768
+    gin = build_gin([64, 128, 8], 0.5)
+    base = estimate_model(gin, rows, edges)
+    fused = estimate_model(gin, rows, edges, megafuse=True)
+    # GIN layer 0: the [rows, 64] aggregate intermediate vanishes (the
+    # linear's relu is its own epilogue, so its output IS the fused out)
+    drop0 = base.layers[0].bytes_full - fused.layers[0].bytes_full
+    assert drop0 == rows * 64 * 4
+    assert fused.total_full_bytes() < base.total_full_bytes()
+    gcn = build_gcn([64, 128, 8], 0.5)
+    assert estimate_model(gcn, rows, edges, megafuse=True).layers == \
+        estimate_model(gcn, rows, edges).layers
+
+
+# -- bf16 staging stays flat-only (satellite: decision pinned) ------------
+
+def test_bf16_staging_units_are_flat_only():
+    """FINAL decision (round 10): the 16-row bf16 STAGING UNIT exists only
+    on the flat schedule — a non-flat unit=16 geometry is a construction
+    error (the slot-padded schedule's 8-row cells would tear the bf16
+    (16, 128) Mosaic tile).  The slot schedule keeps its original
+    precision-keyed contract (bf16 fast / fp32 exact); the flat schedule's
+    dtype is a pure function of the geometry."""
+    with pytest.raises(AssertionError, match="flat"):
+        B.Geometry(sb=256, ch=512, slot=128, rb=256, ch2=512,
+                   unit=16).check()
+    slot_geom = B.Geometry(sb=256, ch=512, slot=128, rb=256, ch2=512)
+    assert B.staging_dtype(slot_geom, False) == jnp.bfloat16
+    assert B.staging_dtype(slot_geom, True) == jnp.float32
+    assert B.staging_dtype(GF, False) == jnp.float32    # 8-row unit
+    assert B.staging_dtype(GFB, False) == jnp.bfloat16  # 16-row unit
+
+
+def test_bf16_twopass_bitwise_vs_fp32_unit(monkeypatch):
+    """With phase fusion OFF (two-pass flat schedule), bf16 16-row
+    staging must still be bitwise the fp32 8-row unit's result on
+    integer data — the staging dtype changes bytes moved, never sums."""
+    monkeypatch.setenv("ROC_BINNED_NO_FUSE", "1")
+    n, t, e, h = 700, 700, 5000, 64
+    src, dst, x = _int_graph(n, t, e, h, 42)
+    p32 = B.build_binned_plan(src, dst, n, t, geom=GF)
+    p16 = B.build_binned_plan(src, dst, n, t, geom=GFB)
+    o32 = np.asarray(B.run_binned(jnp.asarray(x), p32, interpret=True))
+    o16 = np.asarray(B.run_binned(jnp.asarray(x), p16, interpret=True))
+    np.testing.assert_array_equal(o16, o32)

@@ -22,6 +22,18 @@ not a clean 0.5 because the 16-row bf16 unit pads every touched cell to
 twice the rows of the 8-row fp32 unit (measured ~0.52 on the uniform
 synthetic shapes); 0.6 leaves headroom without letting the claim decay.
 
+Megakernel rows (PR "whole-layer megakernel"): every shape also carries a
+``megakernel`` entry — does the fused aggregate->linear schedule ATTACH
+(group staging <= _FUSE_MAX_STG_ROWS), its real-chunk step count, the
+phase-2 chunk count C2, and whether the trace-time VMEM gate admits the
+kernel at H=128/256.  At the dense shapes the honest answer is attach=
+false — the fused schedule is a SHARD-SCALE optimization (per-group
+staging must fit VMEM), so the gate runs at ``mega_shard_scaled``: the
+megakernel's steps must be <= 0.85x the two-pass LAYER cost (aggregation
+steps + the rb-row output sweep the separate linear pass adds), and the
+predicted per-layer HBM traffic at the Reddit shape must drop by at least
+the intermediate's write + read (binned.predicted_layer_hbm_bytes).
+
     python tools/check_kernel_budgets.py            # diff, exit 1 on drift
     python tools/check_kernel_budgets.py --update   # regenerate the table
 """
@@ -41,6 +53,11 @@ BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 SHAPES = [
     ("reddit_scaled", 32768, 4_194_304, 0),
     ("products_scaled", 262_144, 2_097_152, 1),
+    # Shard-scale shape where the fused aggregate->linear schedule
+    # genuinely attaches AND the megakernel's VMEM gate admits it (bf16
+    # staging at H=128); degree 8, roughly one greedy-cut shard of a
+    # medium graph.
+    ("mega_shard_scaled", 1024, 8192, 2),
 ]
 
 # Max allowed flat/default total-step ratio at the Reddit-scale shape
@@ -51,6 +68,17 @@ FLAT_MAX_RATIO = 0.75
 # (the bf16-storage acceptance criterion: ~2x fewer staging bytes; the
 # 16-row unit's extra cell padding keeps it above a clean 0.5).
 BF16_MAX_RATIO = 0.6
+
+# Max allowed megakernel / two-pass-LAYER step ratio at the mega shard
+# shape.  The two-pass layer pays the aggregation grid PLUS a separate
+# linear pass that sweeps the [rows, H] aggregate again (priced at one
+# step per rb-row window, the same window unit the kernel uses); the
+# megakernel runs the fused grid's real chunks only and issues the matmul
+# from VMEM, so it must clear the whole-layer budget with >= 15% margin.
+MEGA_MAX_RATIO = 0.85
+
+# Hidden width the megakernel HBM pin is evaluated at (binned._MODEL_H).
+MEGA_H = 256
 
 
 def _geometries():
@@ -87,8 +115,41 @@ def compute_table():
                 "staging_dtype": str(B.staging_dtype(geom, False).__name__),
                 "staging_bytes": int(B.staging_bytes_for(src, dst, geom)),
             }
+        entry["megakernel"] = _mega_entry(src, dst, n, e)
         table[name] = entry
     return table
+
+
+def _mega_entry(src, dst, n, e):
+    """Megakernel row for one shape: attach/steps/C2/VMEM admission per
+    flat geometry, the two-pass LAYER step cost it competes against, and
+    the predicted per-layer HBM bytes either way at H=MEGA_H."""
+    import roc_tpu.ops.pallas.binned as B
+    out = {
+        "hbm_layer_bytes_unfused":
+            int(B.predicted_layer_hbm_bytes(n, MEGA_H, MEGA_H)),
+        "hbm_layer_bytes_mega":
+            int(B.predicted_layer_hbm_bytes(n, MEGA_H, MEGA_H, mega=True)),
+    }
+    for gname, geom in [("flat", B.GEOM_FLAT),
+                        ("flat_bf16", B.GEOM_FLAT_BF16)]:
+        cb, cn, cnt = B._cell_stats(src, dst, geom.sb, geom.rb)
+        _, s1, s2 = B._plan_steps(cb, cn, cnt, geom, n, n, e)
+        lin_steps = -(-n // geom.rb)
+        row = {"attaches": False,
+               "twopass_layer_steps": int(s1 + s2 + lin_steps)}
+        r = B._fused_sched_stats(cb, cn, cnt, geom, n, n, e)
+        if r is not None:
+            steps, c2 = r
+            row.update({
+                "attaches": True,
+                "mega_steps": int(steps),
+                "c2": int(c2),
+                "vmem_ok_h128": bool(B._mega_vmem_ok(geom, 128, 128, c2)),
+                "vmem_ok_h256": bool(B._mega_vmem_ok(geom, 256, 256, c2)),
+            })
+        out[gname] = row
+    return out
 
 
 def check_flat_claim(table):
@@ -107,11 +168,45 @@ def check_flat_claim(table):
     return problems
 
 
+def check_mega_claim(table):
+    problems = []
+    m = table["mega_shard_scaled"]["megakernel"]
+    for gname in ("flat", "flat_bf16"):
+        row = m[gname]
+        if not row["attaches"]:
+            problems.append(f"megakernel no longer attaches at "
+                            f"mega_shard_scaled ({gname})")
+            continue
+        steps, layer = row["mega_steps"], row["twopass_layer_steps"]
+        if steps > MEGA_MAX_RATIO * layer:
+            problems.append(
+                f"megakernel step regression ({gname}): {steps} steps vs "
+                f"two-pass layer {layer} at mega_shard_scaled — ratio "
+                f"{steps / layer:.3f} > {MEGA_MAX_RATIO}")
+    # The VMEM gate must keep admitting the bf16-staged kernel at H=128
+    # (the configuration the parity tests execute); fp32 staging doubling
+    # past the budget at the same C2 is the expected composition story.
+    if m["flat_bf16"]["attaches"] and not m["flat_bf16"]["vmem_ok_h128"]:
+        problems.append("megakernel VMEM gate rejects bf16 staging at "
+                        "H=128 at mega_shard_scaled — kernel never runs")
+    # Reddit-shape HBM pin: fusing must drop at least the intermediate's
+    # write + read (2 * rows * H * 4 bytes).
+    r = table["reddit_scaled"]
+    drop = (r["megakernel"]["hbm_layer_bytes_unfused"]
+            - r["megakernel"]["hbm_layer_bytes_mega"])
+    need = 2 * r["num_rows"] * MEGA_H * 4
+    if drop < need:
+        problems.append(f"megakernel HBM claim: predicted per-layer drop "
+                        f"{drop} < intermediate write+read {need} at "
+                        f"reddit_scaled")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     update = "--update" in argv
     table = compute_table()
-    problems = check_flat_claim(table)
+    problems = check_flat_claim(table) + check_mega_claim(table)
     if update:
         if problems:
             for p in problems:

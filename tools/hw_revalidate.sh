@@ -149,6 +149,19 @@ for flat in 0 1; do
     timeout 900 python tools/sweep_binned.py 512 4096 128 512 4096 \
         2097152 $flat 2>&1 | tail -1 | tee -a "$LOG"
 done
+
+note "4c. megakernel A/B at the mega-shard shape (whole-layer fused"
+note "    aggregate->linear vs two-pass, same seed; the -v losses must"
+note "    agree to ~1e-3 and the mega leg skips one [rows, H] HBM round"
+note "    trip per fused layer — record the epoch-time ratio and the"
+note "    kernel_budgets.json mega row's predicted 8-vs-13 layer steps)."
+note "    ROC_BINNED_GEOM pins flat on BOTH legs so the measured delta is"
+note "    fusion, not the cost model's geometry pick."
+for mf in "" "-megafuse"; do
+    ROC_BINNED_GEOM=flat timeout 900 python -m roc_tpu \
+        -dataset mega-shard -layers 64-128-8 -model gin \
+        -aggr-backend binned -e 10 $mf -v 2>&1 | tail -2 | tee -a "$LOG"
+done
 fi
 
 if [ "$START" -le 5 ]; then
