@@ -152,6 +152,15 @@ ANALYZE = _env("ROC_BENCH_ANALYZE", "0", int)
 # and the canonical vs_baseline / last-known-good claims stay plan-off.
 MEM = _env("ROC_BENCH_MEM", "0", int)
 MEM_PLAN = os.environ.get("ROC_MEM_PLAN", "keep")
+# ROC_BENCH_STREAM=1: run the measured legs through the out-of-core
+# host-streaming executor (-stream; ROC_STREAM is set for the built
+# Config).  The artifact gains a "stream" block with the measured
+# stall/transfer split and overlap fraction — the exit-criterion number
+# for the out-of-core ROADMAP item.  Streamed legs annotate the metric
+# and are excluded from vs_baseline and the canonical persist: they time
+# a different executor.  ROC_STREAM_SLOTS sets the prefetch ring depth.
+STREAM = _env("ROC_BENCH_STREAM", "0", int)
+STREAM_SLOTS = _env("ROC_STREAM_SLOTS", "2", int)
 # ROC_BF16_STORAGE=1 (the same env Config.__post_init__ honors): features
 # stored/staged/exchanged as bf16, fp32 accumulation.  Every artifact is
 # stamped with the storage dtype; bf16 legs annotate the metric and are
@@ -183,7 +192,8 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + ("" if BALANCE_EVERY == 0 else f"_balance{BALANCE_EVERY}")
           + ("" if MEM_PLAN == "keep" else f"_mem-{MEM_PLAN}")
           + ("" if DTYPE == "fp32" else f"_{DTYPE}")
-          + ("" if FUSION == "none" else f"_{FUSION}"))
+          + ("" if FUSION == "none" else f"_{FUSION}")
+          + ("" if not STREAM else f"_stream{STREAM_SLOTS}"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -412,9 +422,11 @@ def run():
     def build_and_warm(backend):
         cfg = Config(layers=LAYERS, num_epochs=1, learning_rate=0.01,
                      weight_decay=1e-4, dropout_rate=0.5, eval_every=10**9,
-                     num_parts=n_dev, halo=True, aggregate_backend=backend,
+                     num_parts=max(n_dev, 2) if STREAM else n_dev,
+                     halo=True, aggregate_backend=backend,
                      aggregate_precision=PRECISION, model=MODEL, heads=HEADS,
-                     balance_every=BALANCE_EVERY)
+                     balance_every=BALANCE_EVERY,
+                     stream=bool(STREAM), stream_slots=STREAM_SLOTS)
         # aggr="": each model's own default (gcn sum, sage avg, ...) so the
         # metric name labels what actually ran
         model = build_model(MODEL, LAYERS, cfg.dropout_rate, "",
@@ -497,7 +509,10 @@ def run():
     epoch_s = sum(times) / len(times)
 
     edges_per_sec_per_chip = ds.graph.num_edges / epoch_s / n_dev
-    resolved = trainer.gdata.backend  # what actually ran (auto resolves)
+    # what actually ran (auto resolves); the streaming executor drives the
+    # segment ops directly and has no per-device gdata bundle
+    resolved = getattr(getattr(trainer, "gdata", None), "backend",
+                       "stream" if STREAM else "none")
     print(f"# {epoch_s*1e3:.1f} ms/epoch on {n_dev} "
           f"{jax.default_backend()} device(s), backend={resolved}, "
           f"{edges_per_sec_per_chip/1e6:.1f}M edges/s/chip", file=sys.stderr)
@@ -526,7 +541,7 @@ def run():
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
         if MODEL == "gcn" and CANONICAL_SHAPE and REORDER == "off"
         and BALANCE_EVERY == 0 and MEM_PLAN == "keep"
-        and DTYPE == "fp32" and FUSION == "none" else None,
+        and DTYPE == "fp32" and FUSION == "none" and not STREAM else None,
         "backend": resolved,                   # what auto resolved to
         "dtype": DTYPE,                        # feature-storage dtype
         "fusion": FUSION,                      # layer-fusion level
@@ -598,7 +613,18 @@ def run():
                 "step_delta_vs_remat": round(
                     plan.predicted_step_s / remat.predicted_step_s - 1, 4),
             }
+        if plan is not None and plan.any_offload():
+            # bench legs must not claim host offload before the streaming
+            # executor is the one running: an OFFLOAD verdict lowered by the
+            # in-core trainers rematerializes instead (planner docstring)
+            mem["offload_executes_as"] = plan.offload_executes_as
         result["memory"] = mem
+    if STREAM:
+        # the ISSUE-9 exit criterion: the artifact records the *measured*
+        # stream/compute overlap fraction, not a predicted one
+        st = getattr(trainer, "stream_stats", None)
+        result["stream"] = st() if callable(st) else {
+            "note": "trainer has no stream stats (fell back to in-core)"}
     reg = getattr(trainer, "_metrics", None)
     if reg is not None:
         # -obs / ROC_OBS=1 run: stamp the unified metrics block (the
@@ -620,7 +646,7 @@ def run():
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0
             and MEM_PLAN == "keep" and "binned_flat" not in result
-            and DTYPE == "fp32" and FUSION == "none"
+            and DTYPE == "fp32" and FUSION == "none" and not STREAM
             and fallback_from is None and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(

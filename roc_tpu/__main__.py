@@ -50,6 +50,30 @@ def main(argv=None) -> int:
         print("error: -check-sharding needs the full graph on one host; "
               "run it without -perhost", file=sys.stderr)
         return 2
+    if cfg.stream:
+        if cfg.num_parts < 2:
+            print("error: -stream needs -parts >= 2 (shards rotate through "
+                  "the device slots; one shard streams nothing)",
+                  file=sys.stderr)
+            return 2
+        if cfg.edge_shard in (True, "on") or cfg.exchange == "ring":
+            print("error: -stream schedules its own shard rotation; "
+                  "-edge-shard / -exchange ring do not compose with it",
+                  file=sys.stderr)
+            return 2
+        if cfg.multihost:
+            print("error: -stream is single-process — it trades host "
+                  "memory for device memory instead of scaling out; "
+                  "drop -multihost", file=sys.stderr)
+            return 2
+        if cfg.check_sharding or cfg.analyze:
+            print("error: -check-sharding/-analyze audit the in-core SPMD "
+                  "step; run them without -stream", file=sys.stderr)
+            return 2
+        if cfg.use_bf16 or cfg.bf16_storage:
+            print("error: -stream is fp32-only for now (bf16 staging "
+                  "changes the streamed byte layout)", file=sys.stderr)
+            return 2
     # Config banner, mirroring gnn.cc:48-60.
     print("        ===== GNN settings =====", file=sys.stderr)
     print(f"        dataset = {cfg.filename or cfg.dataset} seed = {cfg.seed}\n"

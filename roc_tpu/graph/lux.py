@@ -58,7 +58,19 @@ _HEADER_SIZE = 12  # uint32 numNodes + uint64 numEdges (gnn.h:33)
 
 def read_rows_slice(path: str, lo: int, hi: int) -> np.ndarray:
     """raw_rows[lo:hi] (inclusive end offsets) via per-range seek+read (the
-    reference's per-partition seeking, load_task.cu:231-243)."""
+    reference's per-partition seeking, load_task.cu:231-243).
+
+    Range checks run *before* any seek, on both the native and the NumPy
+    path: the stream executor derives these ranges from external (balancer)
+    bounds thousands of times per run, and a bad range must fail loudly
+    here rather than as a short read or a silent negative-count no-op."""
+    if lo < 0 or hi < lo:
+        raise ValueError(f".lux row range [{lo}, {hi}) is invalid "
+                         "(need 0 <= lo <= hi)")
+    num_nodes, _ = read_header(path)    # 12-byte read; uniform EOF check
+    if hi > num_nodes:                  # on the native and NumPy paths
+        raise ValueError(f".lux row range [{lo}, {hi}) runs past the end "
+                         f"of {path} ({num_nodes} nodes)")
     from roc_tpu import native
     if native.available():
         rows, _ = native.lux_read_slice(path, lo, hi, 0, 0)
@@ -66,13 +78,22 @@ def read_rows_slice(path: str, lo: int, hi: int) -> np.ndarray:
     with open(path, "rb") as f:
         f.seek(_HEADER_SIZE + 8 * lo)
         rows = np.fromfile(f, dtype=np.uint64, count=hi - lo)
-    assert rows.shape[0] == hi - lo, "truncated .lux rows"
+    if rows.shape[0] != hi - lo:
+        raise ValueError(f".lux row range [{lo}, {hi}) runs past the end "
+                         f"of {path} (got {rows.shape[0]} offsets)")
     return rows
 
 
 def read_cols_slice(path: str, num_nodes: int, e0: int, e1: int
                     ) -> np.ndarray:
     """raw_cols[e0:e1] (source vertex ids) via per-range seek+read."""
+    if e0 < 0 or e1 < e0:
+        raise ValueError(f".lux edge range [{e0}, {e1}) is invalid "
+                         "(need 0 <= e0 <= e1)")
+    _, num_edges = read_header(path)
+    if e1 > num_edges:
+        raise ValueError(f".lux edge range [{e0}, {e1}) runs past the end "
+                         f"of {path} ({num_edges} edges)")
     from roc_tpu import native
     if native.available():
         _, cols = native.lux_read_slice(path, 0, 0, e0, e1)
@@ -80,7 +101,9 @@ def read_cols_slice(path: str, num_nodes: int, e0: int, e1: int
     with open(path, "rb") as f:
         f.seek(_HEADER_SIZE + 8 * num_nodes + 4 * e0)
         cols = np.fromfile(f, dtype=np.uint32, count=e1 - e0)
-    assert cols.shape[0] == e1 - e0, "truncated .lux cols"
+    if cols.shape[0] != e1 - e0:
+        raise ValueError(f".lux edge range [{e0}, {e1}) runs past the end "
+                         f"of {path} (got {cols.shape[0]} ids)")
     return cols
 
 

@@ -112,21 +112,34 @@ def _unpack_meta(buf: np.ndarray) -> PartitionMeta:
 
 
 def meta_from_lux(path: str, num_parts: int, process_index: int = 0,
-                  allgather: AllGather = single_process_allgather
-                  ) -> PartitionMeta:
+                  allgather: AllGather = single_process_allgather,
+                  bounds=None, shard_nodes: int = 0,
+                  shard_edges: int = 0) -> PartitionMeta:
     """Compute (on process 0) and share the partition geometry.
 
     Only process 0 pays the O(N) row-offset read + greedy cut; everyone else
     receives the packed O(P) result through the allgather (a broadcast is
     just an allgather we read row 0 of — keeps the injected-exchange surface
-    to one primitive)."""
+    to one primitive).
+
+    ``bounds`` / ``shard_nodes`` / ``shard_edges`` pass through to
+    ``compute_meta``: an external cut (a balancer reshard under streaming
+    re-reads moved byte ranges) with the padded shapes frozen to the
+    original geometry, so downstream compiled steps keep their shapes.
+    External bounds are validated (contiguous, non-overlapping, within the
+    file's node range) before any byte range is derived from them —
+    streaming hits this path on every reshard."""
     if process_index == 0:
         num_nodes, num_edges = read_header(path)
         raw_rows = read_rows_slice(path, 0, num_nodes)
         row_ptr = np.zeros(num_nodes + 1, dtype=E_DTYPE)
         row_ptr[1:] = raw_rows.astype(E_DTYPE)
-        assert np.all(np.diff(row_ptr) >= 0), "non-monotone .lux offsets"
-        meta = compute_meta(row_ptr, num_parts)
+        if not np.all(np.diff(row_ptr) >= 0):
+            raise ValueError(f"non-monotone .lux row offsets in {path}: "
+                             "edge ranges would overlap or run backwards")
+        meta = compute_meta(row_ptr, num_parts, bounds=bounds,
+                            shard_nodes=shard_nodes or None,
+                            shard_edges=shard_edges or None)
         packed = _pack_meta(meta)
     else:
         packed = np.zeros(5 + 4 * num_parts, np.int64)

@@ -34,6 +34,9 @@ def main(argv=None) -> int:
                    help="per-device HBM budget (k/m/g/t suffixes)")
     p.add_argument("--mode", default="auto",
                    choices=["auto", "keep", "remat"])
+    p.add_argument("--stream", action="store_true",
+                   help="plan for a -stream run: OFFLOAD verdicts execute "
+                        "as stream-managed host residency, not remat")
     ns = p.parse_args(argv)
     layers = [int(x) for x in ns.layers.split("-")]
     model = build_model(ns.model, layers, heads=ns.heads)
@@ -42,7 +45,8 @@ def main(argv=None) -> int:
     est = estimator.estimate_model(model, ns.rows, ns.edges,
                                    fixed_bytes=fixed)
     plan = planner.plan_memory(est, mode=ns.mode,
-                               budget_bytes=parse_size(ns.budget))
+                               budget_bytes=parse_size(ns.budget),
+                               offload_executed=ns.stream)
     sys.stdout.write(plan.to_json())
     return 0
 

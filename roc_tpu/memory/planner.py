@@ -29,12 +29,17 @@ budget), solved exactly with Pareto-pruned states.  Layer counts above
 plan).
 
 OFFLOAD: a rematted layer whose tagged bytes would round-trip to host
-memory faster than its segment recomputes is RELABELED "offload" — a
-recorded candidate only.  It still executes as remat: TPU v4 has no
-planner-controlled host-offload stream in this codebase (jax host_memory
-spaces are not plumbed through shard_map here), so the label exists to
-size the opportunity in artifacts, not to change the compiled program.
-docs/DESIGN.md §Memory planner.
+memory faster than its segment recomputes is relabeled "offload".  How
+that verdict EXECUTES depends on the run's executor, recorded in
+``MemPlan.offload_executes_as``: under ``-stream`` the verdict is real —
+the stream executor (roc_tpu/stream) keeps boundary activations
+host-resident and the checkpoint policy offloads tagged saves to pinned
+host memory (policy.py, ``offload_executes_as="stream-host"``).  Without
+``-stream`` there is no planner-controlled host-offload path on the
+in-core executors, so OFFLOAD layers still execute as remat and every
+artifact (plan-dump, bench ROC_BENCH_MEM) carries the explicit
+``"offload_executes_as": "remat"`` label rather than implying bytes moved
+that never did.  docs/DESIGN.md §Memory planner, §Streaming executor.
 """
 
 from __future__ import annotations
@@ -47,7 +52,9 @@ from roc_tpu.memory.estimator import ModelEstimate
 
 KEEP = "keep"
 REMAT = "remat"
-OFFLOAD = "offload"     # executes as REMAT; recorded-but-unused on TPU v4
+OFFLOAD = "offload"     # host round-trip beats recompute; executes as
+                        # stream-host residency under -stream, as REMAT
+                        # otherwise (MemPlan.offload_executes_as says which)
 
 # Beyond this many layers the exact DP (L knapsacks, Pareto states) gives
 # way to the greedy pack.  GNNs in this repo are 2-8 layers; 16 is already
@@ -74,6 +81,9 @@ class MemPlan:
     remat_step_s: float
     planner: str                    # fixed | dp | greedy
     feasible: bool                  # predicted peak <= budget (or no budget)
+    # how an OFFLOAD verdict executes in this run: "stream-host" when the
+    # stream executor is active, "remat" otherwise (the honest default)
+    offload_executes_as: str = REMAT
 
     def any_remat(self) -> bool:
         return any(d != KEEP for d in self.decisions)
@@ -81,10 +91,14 @@ class MemPlan:
     def num_remat(self) -> int:
         return sum(d != KEEP for d in self.decisions)
 
+    def any_offload(self) -> bool:
+        return any(d == OFFLOAD for d in self.decisions)
+
     def key(self):
         """The plan's contribution to the structure-keyed step cache: two
         plans with equal keys compile to the same checkpoint policy."""
-        return (self.mode, self.budget_bytes, self.decisions)
+        return (self.mode, self.budget_bytes, self.decisions,
+                self.offload_executes_as)
 
     def to_dict(self) -> dict:
         return {
@@ -100,6 +114,7 @@ class MemPlan:
             "remat_step_s": round(self.remat_step_s, 9),
             "planner": self.planner,
             "feasible": self.feasible,
+            "offload_executes_as": self.offload_executes_as,
         }
 
     def to_json(self) -> str:
@@ -110,11 +125,15 @@ class MemPlan:
     def summary(self) -> str:
         dec = " ".join(f"{n}={d}" for n, d in zip(self.layer_names,
                                                   self.decisions))
+        off = ""
+        if self.any_offload():
+            off = f" (offload executes-as-{self.offload_executes_as})"
         return (f"mem-plan[{self.mode}/{self.planner}] {dec} "
                 f"peak={self.predicted_peak_bytes / 1e6:.1f}MB"
                 f"{'' if self.feasible else ' OVER-BUDGET'} "
                 f"(keep={self.keep_peak_bytes / 1e6:.1f}MB) "
-                f"step=+{(self.predicted_step_s / max(self.keep_step_s, 1e-12) - 1) * 100:.1f}%")
+                f"step=+{(self.predicted_step_s / max(self.keep_step_s, 1e-12) - 1) * 100:.1f}%"
+                f"{off}")
 
 
 def predict_peak(est: ModelEstimate, decisions: Sequence[str]) -> int:
@@ -244,12 +263,15 @@ def _mark_offload(est: ModelEstimate, decisions):
 
 
 def plan_memory(est: ModelEstimate, mode: str = "auto",
-                budget_bytes: int = 0) -> MemPlan:
+                budget_bytes: int = 0,
+                offload_executed: bool = False) -> MemPlan:
     """Compile a :class:`MemPlan` for the given estimates.
 
     ``mode="keep"`` / ``"remat"`` pin every layer (budget ignored);
     ``"auto"`` runs the DP under ``budget_bytes`` (0 = unbounded, which
-    makes all-KEEP optimal by construction).
+    makes all-KEEP optimal by construction).  ``offload_executed`` records
+    whether this run's executor actually moves OFFLOAD bytes to host
+    (the stream executor does; the in-core ones execute them as remat).
     """
     L = len(est.layers)
     if mode == "keep":
@@ -274,6 +296,7 @@ def plan_memory(est: ModelEstimate, mode: str = "auto",
         remat_step_s=predict_time(est, all_remat),
         planner=planner,
         feasible=feasible(est, decisions, int(budget_bytes)),
+        offload_executes_as="stream-host" if offload_executed else REMAT,
     )
 
 

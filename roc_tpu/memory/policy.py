@@ -23,7 +23,7 @@ from typing import Optional, Tuple
 import jax
 
 from roc_tpu import ops
-from roc_tpu.memory.planner import KEEP, MemPlan
+from roc_tpu.memory.planner import KEEP, OFFLOAD, MemPlan
 
 try:
     from jax import checkpoint_policies as _cp
@@ -31,6 +31,11 @@ try:
 except ImportError:       # ancient jax: plans degrade to all-KEEP
     _cp = None
     _HAVE_POLICIES = False
+# Real host offload for OFFLOAD verdicts (stream executor runs only):
+# saved-but-offloaded residuals park in pinned host memory between the
+# forward and backward pass instead of staying in HBM.
+_HAVE_OFFLOAD = _HAVE_POLICIES and \
+    hasattr(_cp, "save_and_offload_only_these_names")
 
 
 def saved_names(model, plan: MemPlan) -> Tuple[str, ...]:
@@ -44,19 +49,41 @@ def saved_names(model, plan: MemPlan) -> Tuple[str, ...]:
                  and op.attrs.get("ckpt_save"))
 
 
-def checkpoint_policy(model, plan: Optional[MemPlan]):
-    """The jax.checkpoint policy for a plan; None = no wrap (all-KEEP)."""
+def offload_names(model, plan: MemPlan) -> Tuple[str, ...]:
+    """checkpoint_name tags of OFFLOAD-verdict layers: saved across the
+    fwd/bwd boundary like KEEP, but parked in host memory meanwhile."""
+    off = {i for i, d in enumerate(plan.decisions) if d == OFFLOAD}
+    return tuple(op.attrs["ckpt"] for op in model.ops
+                 if op.attrs.get("layer") in off
+                 and op.attrs.get("ckpt")
+                 and op.attrs.get("ckpt_save"))
+
+
+def checkpoint_policy(model, plan: Optional[MemPlan],
+                      offload_to_host: bool = False):
+    """The jax.checkpoint policy for a plan; None = no wrap (all-KEEP).
+
+    With ``offload_to_host`` (the stream executor's runs) an OFFLOAD
+    verdict compiles to ``save_and_offload_only_these_names``: the
+    layer's tagged residuals are saved to pinned host memory and fetched
+    back for the backward pass.  Otherwise OFFLOAD degrades to remat —
+    the plan records which via ``offload_executes_as``."""
     if plan is None or not plan.any_remat() or not _HAVE_POLICIES:
         return None
+    if offload_to_host and plan.any_offload() and _HAVE_OFFLOAD:
+        return _cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=list(saved_names(model, plan)),
+            names_which_can_be_offloaded=list(offload_names(model, plan)),
+            offload_src="device", offload_dst="pinned_host")
     return _cp.save_only_these_names(*saved_names(model, plan))
 
 
-def loss_fn(model, plan: Optional[MemPlan]):
+def loss_fn(model, plan: Optional[MemPlan], offload_to_host: bool = False):
     """A drop-in replacement for ``model.loss`` that applies the plan's
     checkpoint policy around the forward pass.  Returns ``model.loss``
     itself when the plan keeps everything, so default runs trace the
     exact same program as before the planner existed."""
-    policy = checkpoint_policy(model, plan)
+    policy = checkpoint_policy(model, plan, offload_to_host)
     if policy is None:
         return model.loss
 

@@ -50,6 +50,10 @@ class PerfWatchdog:
         self.ewma: Optional[float] = float(seed_s) if self.seeded else None
         self.observed = 0
         self.alerts: List[dict] = []
+        # stream-stall EWMA (fraction of epoch wall spent blocked on
+        # host->device prefetch; stream executor runs only)
+        self.stall_ewma: Optional[float] = None
+        self.stall_observed = 0
 
     def observe_epoch(self, epoch: int, wall_s: float) -> Optional[dict]:
         """Feed one epoch's wall time; returns an alert dict or None."""
@@ -74,6 +78,33 @@ class PerfWatchdog:
         self.observed += 1
         return alert
 
+    def observe_stream(self, epoch: int,
+                       stall_frac: float) -> Optional[dict]:
+        """Feed one streamed epoch's stall fraction (stream executor:
+        stall_s / epoch wall).  Straggler-style alert when it exceeds
+        ``ratio`` x its own EWMA — the signal that prefetch stopped hiding
+        transfers (store contention, a slow host read, ring too shallow).
+        Near-zero baselines are floored so a 0.001 -> 0.003 wiggle on a
+        fully-overlapped run doesn't page anyone."""
+        frac = float(stall_frac)
+        armed = self.stall_ewma is not None and \
+            self.stall_observed >= self.warmup
+        baseline = max(self.stall_ewma or 0.0, 0.02)
+        alert = None
+        if armed and frac > self.ratio * baseline:
+            alert = {"kind": "stream-stall", "epoch": int(epoch),
+                     "stall_frac": frac, "ewma": float(self.stall_ewma),
+                     "ratio": frac / baseline}
+            self.alerts.append(alert)
+            frac = self.ratio * baseline  # clamp, as observe_epoch does
+        if self.stall_observed >= 1:
+            # epoch 0 stalls on every first-touch transfer while the jit
+            # compiles; never let it set the baseline
+            self.stall_ewma = frac if self.stall_ewma is None else \
+                self.alpha * frac + (1.0 - self.alpha) * self.stall_ewma
+        self.stall_observed += 1
+        return alert
+
     def observe_shards(self, epoch: int, times_s) -> List[dict]:
         """Feed per-shard probe times (balance/manager.py's samples);
         returns straggler alerts (possibly empty)."""
@@ -93,13 +124,15 @@ class PerfWatchdog:
         return alerts
 
     def verdict(self) -> str:
-        """"regressed" if any slow-epoch fired, "straggler" if only shard
-        alerts did, "ok" otherwise — stamped into bench artifacts."""
+        """"regressed" if any slow-epoch fired, then "straggler", then
+        "stream-stall", "ok" otherwise — stamped into bench artifacts."""
         kinds = {a["kind"] for a in self.alerts}
         if "slow-epoch" in kinds:
             return "regressed"
         if "straggler" in kinds:
             return "straggler"
+        if "stream-stall" in kinds:
+            return "stream-stall"
         return "ok"
 
 

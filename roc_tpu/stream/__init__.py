@@ -1,0 +1,48 @@
+"""Out-of-core host-streaming executor (PyTorch-Direct direction, PAPERS.md).
+
+Full-graph training normally requires the partitioned graph — features,
+boundary activations, edge arrays — to fit aggregate device memory.  This
+package removes that ceiling: shards live in host memory and rotate through
+a fixed set of frozen padded device slots, with a bounded prefetch ring
+(``ring.PrefetchRing``) overlapping the host→device transfer of shard i+1
+with the compute of shard i.  Because every rotation reuses the same padded
+shapes, the jitted per-segment step functions compile once and hit their
+cache for every shard — the same frozen-shape trick the balancer's reshard
+relies on (zero retraces; tests/test_stream.py pins it under RetraceGuard).
+
+Layout: ``segments.py`` splits the model op IR at aggregation boundaries
+(the only non-row-local ops); ``executor.py`` drives the per-epoch shard
+rotation and owns the host-resident stores.  The memory planner's OFFLOAD
+verdict compiles to this executor's host residency (-stream), instead of
+silently executing as remat (roc_tpu/memory/policy.py).
+"""
+
+from __future__ import annotations
+
+from roc_tpu.stream.ring import PrefetchRing
+from roc_tpu.stream.segments import Segment, split_segments
+
+__all__ = ["PrefetchRing", "Segment", "split_segments",
+           "incore_resident_bytes", "StreamTrainer"]
+
+
+def incore_resident_bytes(dataset) -> int:
+    """Estimate of what the in-core path keeps device-resident for this
+    dataset: fp32 features + one-hot labels + mask + in-degree per node,
+    plus the int32 src/dst edge arrays.  The -stream-budget gate compares
+    this against the configured aggregate device budget — activations and
+    params are workload-dependent and excluded, so the gate is a floor
+    (if even the placed data misses the budget, the run cannot fit)."""
+    g = dataset.graph
+    n, e = int(g.num_nodes), int(g.num_edges)
+    per_node = 4 * dataset.in_dim + 4 * dataset.num_classes + 4 + 4
+    return n * per_node + 8 * e
+
+
+def __getattr__(name):
+    # StreamTrainer imports jax at module load; keep `import roc_tpu.stream`
+    # cheap for the gate-only callers (make_trainer's budget check).
+    if name == "StreamTrainer":
+        from roc_tpu.stream.executor import StreamTrainer
+        return StreamTrainer
+    raise AttributeError(name)

@@ -1,0 +1,626 @@
+"""StreamTrainer: the out-of-core shard-rotation executor behind -stream.
+
+The full padded graph — features, labels, edge arrays, and every
+segment-boundary activation — lives in host memory as numpy stores of
+shape ``[P*S, d]`` (shard-major, the same padded layout the SPMD path puts
+on device).  Device memory only ever holds ``stream_slots`` shard slots:
+the one being computed plus the prefetch depth.  An epoch is a sequence of
+*sweeps* — one per model segment (segments.py), forward then reverse — and
+each sweep rotates all P shards through the slots while the PrefetchRing
+transfers shard i+1 under shard i's compute.
+
+Per-segment jitted functions take every shard-varying tensor (table, own
+rows, edge arrays, cotangents) as *arguments*, so all P rotations and all
+epochs share one trace per function — the zero-retrace property the
+RetraceGuard test pins.  The backward pass recomputes each segment's
+forward from its host-stored inputs (rematerialize-from-host: the
+streaming analog of the memory planner's REMAT, which is why the planner's
+OFFLOAD verdict compiles to this executor), accumulating parameter
+gradients on device and activation cotangents in host stores via the
+transposed table gather (``np.add.at`` over the same ``[S + P*K]`` table
+index map the forward used).
+
+Parity: per-shard loss terms and metric tallies are pure sums
+(ops/softmax.py), so the streamed epoch computes the same loss/gradient as
+the in-core step up to float reassociation; Adam (weight decay included)
+then applies the identical update.  tests/test_stream.py holds the 3-epoch
+loss gap under 1e-3.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from roc_tpu import obs, ops
+from roc_tpu.analysis import retrace as _retrace
+from roc_tpu.graph import shard_load
+from roc_tpu.graph.csr import Csr
+from roc_tpu.graph.lux import LUX_SUFFIX
+from roc_tpu.graph.partition import _round_up, partition_graph
+from roc_tpu.ops.softmax import MASK_NONE
+from roc_tpu.stream.ring import PrefetchRing
+from roc_tpu.stream.segments import run_segment, split_segments
+from roc_tpu.train.driver import BaseTrainer
+
+__all__ = ["StreamTrainer"]
+
+_tree_map = jax.tree_util.tree_map
+
+
+def _stream_maps(meta, edge_src, K_force=None):
+    """Frozen-shape halo maps for the rotating table gather.
+
+    Returns ``(K, tbl_idx, esrc_local)`` where ``tbl_idx[i]`` gathers
+    shard i's ``[S + P*K]`` source table from a ``[P*S, d]`` host store
+    (first S entries = own rows, then K halo rows per owner, unfilled
+    entries parked on each owner's guaranteed pad row S-1), and
+    ``esrc_local[i]`` rewrites the padded-global edge sources into that
+    table — the same local+halo layout ``shard_load.build_halo_local``
+    gives the perhost SPMD path, with the per-(i,q) need lists collapsed
+    to one frozen width K so every rotation and every reshard reuses the
+    compiled step (``K_force`` pins K across reshards; a cut that needs
+    more halo than the frozen K raises instead of silently retracing)."""
+    P, S, E = int(meta.num_parts), int(meta.shard_nodes), int(meta.shard_edges)
+    need = []
+    kmax = 1
+    for i in range(P):
+        src = np.asarray(edge_src[i], np.int64)
+        owner = src // S
+        per = {}
+        for q in np.unique(owner[owner != i]):
+            rows = np.unique(src[owner == q] - q * S)
+            per[int(q)] = rows
+            kmax = max(kmax, len(rows))
+        need.append(per)
+    if K_force is None:
+        # headroom over the observed worst per-owner halo need: a later
+        # balancer cut shifts boundary nodes between owners, and the
+        # frozen K must absorb the move without retracing (25% + one
+        # alignment unit, mirroring the padded-shape slack elsewhere)
+        K = _round_up(kmax + max(8, kmax // 4), 8)
+    else:
+        if kmax > K_force:
+            raise ValueError(
+                f"stream reshard: new cut needs halo width {kmax} > frozen "
+                f"K={K_force}; restart -stream to rebuild the slot shapes")
+        K = int(K_force)
+
+    tbl_idx = np.empty((P, S + P * K), np.int64)
+    esrc_local = np.empty((P, E), np.int32)
+    owners_base = np.repeat(np.arange(P, dtype=np.int64) * S + (S - 1), K)
+    for i in range(P):
+        halo = owners_base.copy()
+        for q, rows in need[i].items():
+            halo[q * K: q * K + len(rows)] = q * S + rows
+        tbl_idx[i, :S] = i * S + np.arange(S, dtype=np.int64)
+        tbl_idx[i, S:] = halo
+        src = np.asarray(edge_src[i], np.int64)
+        owner = src // S
+        local = src - owner * S
+        out = local.copy()  # own rows (incl. pad edges) index directly
+        for q, rows in need[i].items():
+            sel = owner == q
+            out[sel] = S + q * K + np.searchsorted(rows, local[sel])
+        esrc_local[i] = out.astype(np.int32)
+    return K, tbl_idx, esrc_local
+
+
+class StreamTrainer(BaseTrainer):
+    """Host-streaming trainer: fixed device slots, rotating shards."""
+
+    # -- setup -------------------------------------------------------------
+
+    def _setup(self):
+        cfg, ds = self.config, self.dataset
+        if self.dtype != jnp.float32 or cfg.bf16_storage:
+            raise SystemExit("error: -stream is fp32-only for now (bf16 "
+                             "staging changes the streamed byte layout)")
+        P = int(cfg.num_parts)
+        if P < 2:
+            raise SystemExit("error: -stream needs -parts >= 2 (one slot "
+                             "computing, at least one in flight)")
+        self._P = P
+        self._lux_path = ""
+        g = ds.graph
+        if isinstance(g, Csr):
+            self.part = partition_graph(g, P)
+            meta = self.part.meta
+            edge_src = np.asarray(self.part.edge_src)
+            edge_dst = np.asarray(self.part.edge_dst)
+            in_degree = np.asarray(self.part.in_degree, np.float32)
+        else:
+            # GraphStub: stream straight off the .lux byte ranges — the
+            # graph is never materialized whole, on host or device.
+            if jax.process_count() > 1:
+                raise SystemExit("error: -stream is single-process (it is "
+                                 "the out-of-core alternative to scaling "
+                                 "out across hosts)")
+            self._lux_path = cfg.filename + LUX_SUFFIX
+            meta = shard_load.meta_from_lux(self._lux_path, P)
+            self.part = meta
+            edge_src, edge_dst, in_degree = self._load_lux_shards(meta)
+
+        self.segments = split_segments(self.model)
+        self._nseg = len(self.segments)
+        self._install_graph(meta, edge_src, edge_dst, in_degree)
+        self._alloc_stores()
+        self.params = self.model.init_params(self.key)
+        self.opt_state = self.optimizer.init(self.params)
+        self.num_nodes = int(meta.num_nodes)
+        self._resolve_mem_plan()
+        self._build_steps()
+
+        self._ring = PrefetchRing(cfg.stream_slots, self._fetch)
+        self._keys = None
+        self._grad_acc = None
+        self._xfer_bytes = 0
+        self._logits_sink = None
+        self._epoch_stream = []
+        self._last_stream_stats = None
+        if cfg.verbose:
+            budget = cfg.stream_budget_bytes()
+            held = cfg.stream_slots * self.slot_bytes()
+            note = ""
+            if budget:
+                note = (f" vs budget {budget / 2**20:.0f} MiB "
+                        f"({'fits' if held <= budget else 'OVER'})")
+            print(f"# stream: {P} shards x {self._nseg} segments through "
+                  f"{cfg.stream_slots} slots, ~{held / 2**20:.1f} MiB "
+                  f"device-resident{note}, halo K={self._K}")
+
+    def _load_lux_shards(self, meta):
+        shards = shard_load.load_local_shards(
+            self._lux_path, meta, list(range(int(meta.num_parts))))
+        return (np.asarray(shards.edge_src),
+                np.asarray(shards.edge_dst),
+                np.asarray(shards.in_degree, np.float32))
+
+    def _install_graph(self, meta, edge_src, edge_dst, in_degree,
+                       K_force=None):
+        """(Re)bind everything derived from the current cut: table/edge
+        maps plus the padded node-data stores.  Boundary-activation stores
+        are allocated once (`_alloc_stores`) — their [P*S, d] shapes do not
+        depend on the cut, which is what keeps reshard retrace-free."""
+        self._meta = meta
+        self._S = int(meta.shard_nodes)
+        self._E = int(meta.shard_edges)
+        K, tbl_idx, esrc_local = _stream_maps(meta, edge_src, K_force)
+        self._K = K
+        self._tbl_idx = tbl_idx
+        self._esrc = esrc_local
+        self._edst = np.asarray(edge_dst, np.int32)
+        self._indeg = np.asarray(in_degree, np.float32)
+        self._edges_valid = jnp.asarray(
+            np.asarray(meta.num_edges_valid), jnp.int32)
+        ds = self.dataset
+        self._store_x = np.asarray(meta.pad_nodes(ds.features), np.float32)
+        self._labels = np.asarray(
+            meta.pad_nodes(ds.onehot_labels()), np.float32)
+        self._mask = np.asarray(
+            meta.pad_nodes(np.asarray(ds.mask), fill=MASK_NONE), np.int32)
+        if hasattr(self, "_stores"):
+            self._stores[0] = self._store_x
+
+    def _alloc_stores(self):
+        """Host stores for segment-boundary activations and their
+        cotangents; tid 0 aliases the padded feature store."""
+        PS = self._P * self._S
+        dims = {}
+        for seg in self.segments:
+            dims.update(seg.out_dims)
+        self._stores = {0: self._store_x}
+        self._cots = {}
+        for seg in self.segments:
+            for t in seg.out_tids:
+                self._stores[t] = np.zeros((PS, dims[t]), np.float32)
+                self._cots[t] = np.zeros((PS, dims[t]), np.float32)
+
+    def slot_bytes(self) -> int:
+        """Worst-case bytes one device slot holds (table + own rows +
+        outputs + edge arrays) — what -stream-budget should be sized to,
+        times the ring depth."""
+        S, E, T = self._S, self._E, self._S + self._P * self._K
+        worst = 0
+        for seg in self.segments:
+            b = E * 8 + S * 4  # esrc + edst int32, indeg f32
+            if seg.head is not None:
+                b += T * seg.out_dims[seg.table_tid] * 4
+            for t in seg.own_in_tids:
+                b += S * seg.out_dims[t] * 4
+            for t in seg.out_tids:
+                b += 2 * S * seg.out_dims[t] * 4  # value + cotangent
+            worst = max(worst, b)
+        return worst
+
+    def _balance_supported(self) -> bool:
+        # The balancer's probe harness reads full per-part edge arrays
+        # (trainer.part) and the in-memory CSR; the lux path still
+        # supports reshard() itself (re-reading moved byte ranges).
+        return isinstance(self.dataset.graph, Csr) \
+            and jax.process_count() == 1
+
+    # -- jitted per-segment steps ------------------------------------------
+
+    def _build_steps(self):
+        self._fwd = [self._make_fwd(s) for s in self.segments[:-1]]
+        self._bwd = [self._make_bwd(s) for s in self.segments]
+        self._ev = [self._make_eval(s) for s in self.segments]
+        opt = self.optimizer
+
+        @jax.jit
+        def update(params, grads, opt_state, alpha):
+            _retrace.note_trace("stream_update")
+            return opt.update(params, grads, opt_state, alpha)
+
+        self._update = update
+
+    def _make_fwd(self, seg):
+        S, outs, name = self._S, seg.out_tids, f"stream_fwd{seg.index}"
+        if seg.head is None:
+            @jax.jit
+            def fwd(params, own, esrc, edst, indeg, key):
+                _retrace.note_trace(name)
+                vals = run_segment(seg, params, None, own, esrc, edst,
+                                   indeg, key, True, S)
+                return {t: vals[t] for t in outs}
+        else:
+            @jax.jit
+            def fwd(params, table, own, esrc, edst, indeg, key):
+                _retrace.note_trace(name)
+                vals = run_segment(seg, params, table, own, esrc, edst,
+                                   indeg, key, True, S)
+                return {t: vals[t] for t in outs}
+        return fwd
+
+    def _make_bwd(self, seg):
+        S, name = self._S, f"stream_bwd{seg.index}"
+        logits_tid = self.model.logits.id
+        if seg.is_last:
+            if seg.head is None:
+                @jax.jit
+                def bwd(params, own, esrc, edst, indeg, key, labels, mask):
+                    _retrace.note_trace(name)
+
+                    def f(p, ow):
+                        vals = run_segment(seg, p, None, ow, esrc, edst,
+                                           indeg, key, True, S)
+                        return ops.masked_softmax_cross_entropy(
+                            vals[logits_tid], labels, mask)
+
+                    loss, (dp, down) = jax.value_and_grad(
+                        f, argnums=(0, 1))(params, own)
+                    return loss, dp, None, down
+            else:
+                @jax.jit
+                def bwd(params, table, own, esrc, edst, indeg, key,
+                        labels, mask):
+                    _retrace.note_trace(name)
+
+                    def f(p, tab, ow):
+                        vals = run_segment(seg, p, tab, ow, esrc, edst,
+                                           indeg, key, True, S)
+                        return ops.masked_softmax_cross_entropy(
+                            vals[logits_tid], labels, mask)
+
+                    loss, (dp, dt, down) = jax.value_and_grad(
+                        f, argnums=(0, 1, 2))(params, table, own)
+                    return loss, dp, dt, down
+        else:
+            outs = seg.out_tids
+            if seg.head is None:
+                @jax.jit
+                def bwd(params, own, esrc, edst, indeg, key, cots):
+                    _retrace.note_trace(name)
+
+                    def f(p, ow):
+                        vals = run_segment(seg, p, None, ow, esrc, edst,
+                                           indeg, key, True, S)
+                        return {t: vals[t] for t in outs}
+
+                    _, vjp = jax.vjp(f, params, own)
+                    dp, down = vjp(cots)
+                    return dp, None, down
+            else:
+                @jax.jit
+                def bwd(params, table, own, esrc, edst, indeg, key, cots):
+                    _retrace.note_trace(name)
+
+                    def f(p, tab, ow):
+                        vals = run_segment(seg, p, tab, ow, esrc, edst,
+                                           indeg, key, True, S)
+                        return {t: vals[t] for t in outs}
+
+                    _, vjp = jax.vjp(f, params, table, own)
+                    dp, dt, down = vjp(cots)
+                    return dp, dt, down
+        return bwd
+
+    def _make_eval(self, seg):
+        S, name = self._S, f"stream_eval{seg.index}"
+        if seg.is_last:
+            logits_tid = self.model.logits.id
+            if seg.head is None:
+                @jax.jit
+                def ev(params, own, esrc, edst, indeg, labels, mask):
+                    _retrace.note_trace(name)
+                    vals = run_segment(seg, params, None, own, esrc, edst,
+                                       indeg, None, False, S)
+                    logits = vals[logits_tid]
+                    return logits, ops.perf_metrics(logits, labels, mask)
+            else:
+                @jax.jit
+                def ev(params, table, own, esrc, edst, indeg, labels, mask):
+                    _retrace.note_trace(name)
+                    vals = run_segment(seg, params, table, own, esrc, edst,
+                                       indeg, None, False, S)
+                    logits = vals[logits_tid]
+                    return logits, ops.perf_metrics(logits, labels, mask)
+        else:
+            outs = seg.out_tids
+            if seg.head is None:
+                @jax.jit
+                def ev(params, own, esrc, edst, indeg):
+                    _retrace.note_trace(name)
+                    vals = run_segment(seg, params, None, own, esrc, edst,
+                                       indeg, None, False, S)
+                    return {t: vals[t] for t in outs}
+            else:
+                @jax.jit
+                def ev(params, table, own, esrc, edst, indeg):
+                    _retrace.note_trace(name)
+                    vals = run_segment(seg, params, table, own, esrc, edst,
+                                       indeg, None, False, S)
+                    return {t: vals[t] for t in outs}
+        return ev
+
+    # -- host<->device staging ---------------------------------------------
+
+    def _fetch(self, item):
+        """Worker-side slot assembly: gather one shard's inputs from the
+        host stores and ship them.  Runs on the ring's prefetch thread,
+        overlapped with the previous shard's compute."""
+        phase, k, i = item
+        seg = self.segments[k]
+        S = self._S
+        lo = i * S
+        a = {"esrc": self._esrc[i], "edst": self._edst[i],
+             "indeg": self._indeg[i]}
+        if seg.head is not None:
+            with obs.span("stream_gather", seg=k, shard=i):
+                a["table"] = self._stores[seg.table_tid][self._tbl_idx[i]]
+        a["own"] = {t: self._stores[t][lo:lo + S]
+                    for t in seg.own_in_tids}
+        if phase != "eval":
+            a["key"] = self._keys[i]
+        if seg.is_last:
+            a["labels"] = self._labels[lo:lo + S]
+            a["mask"] = self._mask[lo:lo + S]
+        if phase == "bwd" and not seg.is_last:
+            a["cots"] = {t: self._cots[t][lo:lo + S] for t in seg.out_tids}
+        self._xfer_bytes += sum(
+            getattr(v, "nbytes", 0) for v in jax.tree_util.tree_leaves(a))
+        with obs.span("stream_transfer", seg=k, shard=i):
+            a = jax.device_put(a)
+            jax.block_until_ready(a)
+        return a
+
+    def _sweep(self, phase, k, consume):
+        """Rotate all P shards of one (phase, segment) sweep through the
+        slots.  Prefetch never crosses the sweep boundary: the next
+        sweep's inputs include stores this sweep is still writing."""
+        ring = self._ring
+        items = [(phase, k, i) for i in range(self._P)]
+        for j, it in enumerate(items):
+            for nxt in items[j:j + ring.num_slots]:
+                if not ring.ensure(nxt):
+                    break
+            a = ring.wait(it)
+            with obs.span("stream_rotate", phase=phase, seg=k, shard=it[2]):
+                consume(it[2], a)
+
+    def _write_outs(self, i, outs):
+        lo = i * self._S
+        for t, arr in jax.device_get(outs).items():
+            self._stores[t][lo:lo + self._S] = arr
+
+    def _scatter_table(self, seg, i, dt):
+        cot = self._cots.get(seg.table_tid)
+        if cot is None:  # the table was the input features; nothing upstream
+            return
+        np.add.at(cot, self._tbl_idx[i], np.asarray(dt))
+
+    def _scatter_own(self, seg, i, down):
+        lo = i * self._S
+        for t, arr in (down or {}).items():
+            cot = self._cots.get(t)
+            if cot is not None:
+                cot[lo:lo + self._S] += np.asarray(arr)
+
+    # -- epoch execution ---------------------------------------------------
+
+    def _run_step(self, step_key, alpha):
+        P, n = self._P, self._nseg
+        ring = self._ring
+        ring.reset_epoch_stats()
+        self._xfer_bytes = 0
+        self._keys = [jax.random.fold_in(step_key, i) for i in range(P)]
+        for c in self._cots.values():
+            c[:] = 0.0
+        self._grad_acc = None
+        loss_parts = []
+
+        with obs.span("stream_epoch", parts=P, segments=n) as sp:
+            for k in range(n - 1):
+                self._sweep("fwd", k, self._consume_fwd(k))
+            for k in range(n - 1, -1, -1):
+                self._sweep("bwd", k, self._consume_bwd(k, loss_parts))
+            self.params, self.opt_state = self._update(
+                self.params, self._grad_acc, self.opt_state, alpha)
+            loss = jnp.sum(jnp.stack(loss_parts))
+        self._note_epoch_stats(sp.dur_s)
+        return loss
+
+    def _consume_fwd(self, k):
+        seg, fn = self.segments[k], self._fwd[k]
+
+        def consume(i, a):
+            if seg.head is None:
+                outs = fn(self.params, a["own"], a["esrc"], a["edst"],
+                          a["indeg"], a["key"])
+            else:
+                outs = fn(self.params, a["table"], a["own"], a["esrc"],
+                          a["edst"], a["indeg"], a["key"])
+            self._write_outs(i, outs)
+
+        return consume
+
+    def _consume_bwd(self, k, loss_parts):
+        seg, fn = self.segments[k], self._bwd[k]
+
+        def consume(i, a):
+            if seg.is_last:
+                tail = (a["key"], a["labels"], a["mask"])
+            else:
+                tail = (a["key"], a["cots"])
+            if seg.head is None:
+                out = fn(self.params, a["own"], a["esrc"], a["edst"],
+                         a["indeg"], *tail)
+            else:
+                out = fn(self.params, a["table"], a["own"], a["esrc"],
+                         a["edst"], a["indeg"], *tail)
+            if seg.is_last:
+                loss, dp, dt, down = out
+                loss_parts.append(loss)
+            else:
+                dp, dt, down = out
+            self._grad_acc = dp if self._grad_acc is None else \
+                _tree_map(jnp.add, self._grad_acc, dp)
+            if dt is not None:
+                self._scatter_table(seg, i, dt)
+            self._scatter_own(seg, i, down)
+
+        return consume
+
+    def _note_epoch_stats(self, wall_s):
+        st = self._ring.epoch_stats()
+        wall = max(float(wall_s), 1e-12)
+        self._last_stream_stats = {
+            "stream_stall_s": round(st["stall_s"], 6),
+            "stream_transfer_s": round(st["transfer_s"], 6),
+            "stream_overlap_frac": round(st["overlap_frac"], 4),
+            "stream_stall_frac": round(min(st["stall_s"] / wall, 1.0), 4),
+            "stream_bytes": int(self._xfer_bytes),
+        }
+        self._epoch_stream.append(
+            dict(self._last_stream_stats, epoch=int(self.epoch)))
+        if self._metrics is not None and self._grad_acc is not None:
+            from roc_tpu.obs import channel as obs_channel
+            self._last_step_metrics = {
+                "grad_norm": obs_channel.global_norm(self._grad_acc),
+                "param_norm": obs_channel.global_norm(self.params),
+                # for the stream executor the wire is the host<->device one
+                "wire_bytes": jnp.float32(self._xfer_bytes),
+                "edges": self._edges_valid,
+            }
+
+    def _obs_epoch_extra(self, epoch):
+        """Streamed-epoch fields merged into the shared obs JSONL record
+        (driver._obs_epoch); stall_frac also feeds the watchdog's
+        stream-stall EWMA."""
+        del epoch
+        return dict(self._last_stream_stats) \
+            if self._last_stream_stats else None
+
+    def stream_stats(self):
+        """Bench-artifact summary: ring geometry + per-epoch overlap."""
+        return dict(self._last_stream_stats or {},
+                    slots=int(self.config.stream_slots),
+                    num_parts=self._P, segments=self._nseg,
+                    halo_width=self._K, slot_bytes=self.slot_bytes(),
+                    epochs=list(self._epoch_stream))
+
+    # -- eval / inference --------------------------------------------------
+
+    def evaluate(self):
+        n = self._nseg
+        for k in range(n - 1):
+            self._sweep("eval", k, self._consume_eval_mid(k))
+        acc = []
+        self._sweep("eval", n - 1, self._consume_eval_last(acc))
+        tot = acc[0]
+        for m in acc[1:]:
+            tot = _tree_map(jnp.add, tot, m)
+        return tot
+
+    def _consume_eval_mid(self, k):
+        seg, fn = self.segments[k], self._ev[k]
+
+        def consume(i, a):
+            if seg.head is None:
+                outs = fn(self.params, a["own"], a["esrc"], a["edst"],
+                          a["indeg"])
+            else:
+                outs = fn(self.params, a["table"], a["own"], a["esrc"],
+                          a["edst"], a["indeg"])
+            self._write_outs(i, outs)
+
+        return consume
+
+    def _consume_eval_last(self, acc):
+        seg, fn = self.segments[-1], self._ev[-1]
+
+        def consume(i, a):
+            if seg.head is None:
+                logits, m = fn(self.params, a["own"], a["esrc"], a["edst"],
+                               a["indeg"], a["labels"], a["mask"])
+            else:
+                logits, m = fn(self.params, a["table"], a["own"], a["esrc"],
+                               a["edst"], a["indeg"], a["labels"], a["mask"])
+            acc.append(m)
+            if self._logits_sink is not None:
+                lo = i * self._S
+                self._logits_sink[lo:lo + self._S] = np.asarray(logits)
+
+        return consume
+
+    def predict_logits(self):
+        """Padded [P*S, C] logits (shard-major, same convention as the
+        SPMD path; ``self._meta.unpad_nodes`` strips the padding)."""
+        self._logits_sink = np.zeros(
+            (self._P * self._S, self.dataset.num_classes), np.float32)
+        try:
+            self.evaluate()
+            return jnp.asarray(self._logits_sink)
+        finally:
+            self._logits_sink = None
+
+    # -- resharding (balancer hook) ----------------------------------------
+
+    def reshard(self, new_bounds) -> float:
+        """Apply a balancer cut under the frozen slot shapes.  Under
+        streaming this is pure host work: re-cut (or re-read, on the .lux
+        path) the moved byte ranges and rebuild the table maps; no step
+        recompiles (same padded shapes, same frozen halo K)."""
+        bounds = np.asarray(new_bounds, np.int64)
+        with obs.span("reshard", parts=self._P, mode="stream") as sp:
+            if self._lux_path:
+                meta = shard_load.meta_from_lux(
+                    self._lux_path, self._P, bounds=bounds,
+                    shard_nodes=self._S, shard_edges=self._E)
+                edge_src, edge_dst, indeg = self._load_lux_shards(meta)
+            else:
+                self.part = partition_graph(
+                    self.dataset.graph, self._P, bounds=bounds,
+                    shard_nodes=self._S, shard_edges=self._E)
+                meta = self.part.meta
+                edge_src = np.asarray(self.part.edge_src)
+                edge_dst = np.asarray(self.part.edge_dst)
+                indeg = np.asarray(self.part.in_degree, np.float32)
+            if self._lux_path:
+                self.part = meta
+            self._install_graph(meta, edge_src, edge_dst, indeg,
+                                K_force=self._K)
+        return sp.dur_s

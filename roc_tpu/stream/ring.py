@@ -1,0 +1,108 @@
+"""Bounded prefetch ring: async host→device staging for the stream executor.
+
+A single background worker drains a FIFO of fetch requests; each request
+gathers one shard's slot tensors from the host-resident stores and ships
+them with ``jax.device_put``.  The ring holds at most ``num_slots``
+requests in flight (the slot currently being computed plus the prefetch
+depth), so device-side staging stays bounded no matter how many shards an
+epoch rotates through — ``num_slots=2`` is classic double buffering.
+
+One worker thread is deliberate: transfers are serialized in submission
+order, so the executor's sweep order is the transfer order and a later
+``ensure`` can never starve the shard the compute loop needs next.
+
+Stall accounting: ``wait`` only counts time spent blocked on a future that
+had not completed when the consumer arrived (``stall_s``); ``busy_s`` is
+the worker's total fetch wall time.  ``overlap_frac`` is the fraction of
+transfer time hidden under compute — the number the bench artifact and the
+watchdog's stream-stall EWMA are built from.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Hashable, Tuple
+
+from roc_tpu import obs
+
+__all__ = ["PrefetchRing"]
+
+
+class PrefetchRing:
+    """FIFO prefetcher over ``fetch_fn(item) -> device pytree``."""
+
+    def __init__(self, num_slots: int, fetch_fn: Callable[[Hashable], Any]):
+        if num_slots < 2:
+            raise ValueError(f"PrefetchRing needs >= 2 slots, got {num_slots}")
+        self.num_slots = int(num_slots)
+        self._fetch_fn = fetch_fn
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="roc-stream-prefetch")
+        self._futures: Dict[Hashable, Future] = {}
+        self._lock = threading.Lock()
+        self.stall_s = 0.0   # consumer time blocked on incomplete fetches
+        self.busy_s = 0.0    # worker time spent gathering + transferring
+
+    # -- worker side --------------------------------------------------------
+
+    def _run(self, item: Hashable) -> Any:
+        with obs.span("stream_prefetch", item=str(item)) as sp:
+            out = self._fetch_fn(item)
+        self.busy_s += sp.dur_s
+        return out
+
+    # -- consumer side ------------------------------------------------------
+
+    def ensure(self, item: Hashable) -> bool:
+        """Queue a fetch for ``item`` if absent and a slot is free."""
+        with self._lock:
+            if item in self._futures:
+                return True
+            if len(self._futures) >= self.num_slots:
+                return False
+            self._futures[item] = self._pool.submit(self._run, item)
+            return True
+
+    def wait(self, item: Hashable) -> Any:
+        """Block until ``item``'s fetch completes and hand over the result.
+
+        Submits the fetch itself if no ``ensure`` reached it (the ring was
+        full at the time) — the consumer can always make progress."""
+        with self._lock:
+            fut = self._futures.pop(item, None)
+            if fut is None:
+                fut = self._pool.submit(self._run, item)
+        if not fut.done():
+            with obs.span("stream_wait", item=str(item)) as sp:
+                out = fut.result()
+            self.stall_s += sp.dur_s
+            return out
+        return fut.result()
+
+    def drain(self) -> None:
+        """Drop queued prefetches (end of a sweep: the next sweep's inputs
+        depend on stores this sweep has not finished writing)."""
+        with self._lock:
+            stale = list(self._futures.values())
+            self._futures.clear()
+        for fut in stale:
+            fut.cancel()
+
+    # -- epoch stats --------------------------------------------------------
+
+    def reset_epoch_stats(self) -> None:
+        self.stall_s = 0.0
+        self.busy_s = 0.0
+
+    def epoch_stats(self) -> Dict[str, float]:
+        overlap = 1.0 - self.stall_s / max(self.busy_s, 1e-12)
+        return {
+            "stall_s": self.stall_s,
+            "transfer_s": self.busy_s,
+            "overlap_frac": min(max(overlap, 0.0), 1.0),
+        }
+
+    def close(self) -> None:
+        self.drain()
+        self._pool.shutdown(wait=True)

@@ -1,0 +1,155 @@
+"""Split the model op IR at aggregation boundaries for shard streaming.
+
+Every op in the IR except ``aggregate``/``gat`` is row-local: row r of the
+output depends only on row r of the input, so it can run on one shard's
+node slot without seeing any other shard.  The two aggregation kinds are
+the only cross-row ops — they read a *source table* indexed by edge
+sources, which under streaming is the gathered ``[S + P*K]`` local+halo
+table the executor assembles from the host stores (the same table layout
+``shard_load.build_halo_local`` gives the perhost SPMD path).
+
+A *segment* is therefore: one optional aggregation head followed by the
+row-local ops up to (not including) the next head.  Segment 0 has no head
+(the ops before the first aggregation, e.g. dropout+linear for GCN).  The
+executor runs each segment as one jitted function per shard, storing the
+segment's boundary outputs back to host between sweeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from roc_tpu.models.model import Model, OpNode
+from roc_tpu.memory.estimator import _op_out_dims
+from roc_tpu import ops
+
+__all__ = ["Segment", "split_segments", "run_segment"]
+
+_HEAD_KINDS = ("aggregate", "gat")
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """One streamable slice of the op IR.
+
+    ``table_tid`` is the tensor the head reads through the local+halo
+    table (-1 when headless); ``own_in_tids`` are earlier-produced
+    tensors the body reads row-locally (only this shard's rows are
+    needed); ``out_tids`` are tensors produced here that any later
+    segment consumes — the executor persists exactly these to host."""
+
+    index: int
+    head: Optional[OpNode]
+    body: Tuple[OpNode, ...]
+    table_tid: int
+    own_in_tids: Tuple[int, ...]
+    out_tids: Tuple[int, ...]
+    is_last: bool
+    out_dims: Dict[int, int]
+
+
+def split_segments(model: Model) -> List[Segment]:
+    ops_list = list(model.ops)
+    dims = _op_out_dims(model)
+    head_pos = [i for i, op in enumerate(ops_list) if op.kind in _HEAD_KINDS]
+    starts = [0] + head_pos
+    ends = head_pos + [len(ops_list)]
+
+    raw = []  # (head, body) per segment
+    for k, (lo, hi) in enumerate(zip(starts, ends)):
+        if k == 0:
+            raw.append((None, tuple(ops_list[lo:hi])))
+        else:
+            raw.append((ops_list[lo], tuple(ops_list[lo + 1:hi])))
+
+    produced = []
+    for head, body in raw:
+        p = {op.out for op in body}
+        if head is not None:
+            p.add(head.out)
+        produced.append(p)
+
+    # tid -> set of segment indices that consume it (as table or row-local)
+    consumers: Dict[int, set] = {}
+    for k, (head, body) in enumerate(raw):
+        tids = set()
+        if head is not None:
+            tids.add(head.inputs[0])
+        for op in body:
+            tids.update(op.inputs)
+        for t in tids:
+            consumers.setdefault(t, set()).add(k)
+
+    segs = []
+    n = len(raw)
+    for k, (head, body) in enumerate(raw):
+        for op in body:
+            assert op.kind not in _HEAD_KINDS, "aggregation op in segment body"
+        own_in = sorted(
+            t for op in body for t in op.inputs if t not in produced[k])
+        outs = sorted(
+            t for t in produced[k]
+            if any(c > k for c in consumers.get(t, ())))
+        touched = produced[k] | set(own_in)
+        if head is not None:
+            touched.add(head.inputs[0])
+        segs.append(Segment(
+            index=k,
+            head=head,
+            body=body,
+            table_tid=head.inputs[0] if head is not None else -1,
+            own_in_tids=tuple(dict.fromkeys(own_in)),
+            out_tids=tuple(outs),
+            is_last=(k == n - 1),
+            out_dims={t: dims[t] for t in touched},
+        ))
+    return segs
+
+
+def run_segment(seg: Segment, params, table, own, esrc, edst, indeg, key,
+                train: bool, num_nodes: int):
+    """Trace one segment for one shard; mirrors ``Model.apply`` dispatch.
+
+    ``table`` is the ``[S + P*K, d]`` gathered source table (None for the
+    headless segment 0), ``own`` maps tid -> this shard's ``[S, d]`` rows,
+    ``esrc``/``edst`` the table-local edge endpoints, ``indeg`` the
+    per-row in-degree.  Returns the full tid -> value map; callers select
+    ``seg.out_tids`` (or the logits tid) from it."""
+    import jax
+
+    vals = dict(own)
+    if seg.head is not None:
+        op = seg.head
+        if op.kind == "aggregate":
+            vals[op.out] = ops.scatter_gather(
+                table, esrc, edst, num_nodes, op.attrs["aggr"])
+        else:  # gat
+            name = op.attrs["param"]
+            kk, fd = op.attrs["heads"], op.attrs["head_dim"]
+            h_tab = ops.linear(table, params[name + "_w"]).reshape(-1, kk, fd)
+            vals[op.out] = ops.gat_attend(
+                h_tab[:num_nodes], h_tab, esrc, edst, num_nodes,
+                params[name + "_asrc"], params[name + "_adst"],
+                op.attrs["slope"],
+            ).reshape(num_nodes, kk * fd)
+
+    for op in seg.body:
+        a = vals[op.inputs[0]]
+        if op.kind == "dropout":
+            k = (jax.random.fold_in(key, op.attrs["slot"])
+                 if train and key is not None else None)
+            out = ops.dropout(k, a, op.attrs["rate"], train)
+        elif op.kind == "linear":
+            out = ops.linear(a, params[op.attrs["param"]],
+                             op.attrs["activation"])
+        elif op.kind == "norm":
+            out = ops.indegree_norm(a, indeg)
+        elif op.kind == "activation":
+            out = ops.apply_activation(a, op.attrs["mode"])
+        elif op.kind == "add":
+            out = ops.add(a, vals[op.inputs[1]])
+        else:  # pragma: no cover - split_segments asserts heads out of body
+            raise ValueError(f"unstreamable op kind {op.kind!r}")
+        vals[op.out] = out
+    return vals

@@ -170,6 +170,21 @@ class Config:
                                   # (k/m/g/t suffixes; "" = the device's
                                   # reported bytes_limit, or unbounded when
                                   # the backend doesn't report one)
+    stream: bool = False          # out-of-core host-streaming executor
+                                  # (roc_tpu/stream): shards live in host
+                                  # memory and rotate through a fixed set
+                                  # of frozen padded device slots, layer-k
+                                  # compute of shard i overlapped with the
+                                  # prefetch of shard i+1.  Requires
+                                  # -parts >= 2; makes the memory planner's
+                                  # OFFLOAD verdict executable
+    stream_slots: int = 2         # prefetch ring depth (device slots in
+                                  # flight; 2 = classic double buffering)
+    stream_budget: str = ""       # aggregate device-memory budget the
+                                  # in-core path is held to (k/m/g/t
+                                  # suffixes).  Without -stream, a graph
+                                  # whose resident bytes exceed it refuses
+                                  # to run in-core — the out-of-core gate
 
     def __post_init__(self):
         # ROC_BALANCE* env overrides so driverless entry points (bench.py,
@@ -196,6 +211,22 @@ class Config:
         if env.get("ROC_MEM_BUDGET"):
             self.mem_budget = env["ROC_MEM_BUDGET"]
         parse_size(self.mem_budget)  # validate eagerly (SystemExit if bad)
+        # ROC_STREAM* mirror -stream/-stream-slots/-stream-budget for
+        # driverless entry points (bench.py, out-of-core test fixtures).
+        if env.get("ROC_STREAM"):
+            self.stream = env["ROC_STREAM"] == "1"
+        try:
+            if "ROC_STREAM_SLOTS" in env:
+                self.stream_slots = int(env["ROC_STREAM_SLOTS"])
+        except ValueError:
+            raise SystemExit("ROC_STREAM_SLOTS must be an integer")
+        if env.get("ROC_STREAM_BUDGET"):
+            self.stream_budget = env["ROC_STREAM_BUDGET"]
+        parse_size(self.stream_budget)  # validate eagerly
+        if self.stream_slots < 2:
+            raise SystemExit(f"stream_slots={self.stream_slots}: the "
+                             "prefetch ring needs >= 2 slots (double "
+                             "buffering is the point)")
         # ROC_BF16_* mirror -bf16-storage/-bf16-rounding/-bf16-exchange for
         # driverless entry points (bench.py, hw_revalidate A/B loops).
         if env.get("ROC_BF16_STORAGE"):
@@ -238,6 +269,10 @@ class Config:
         """-mem-budget in bytes (0 = unset; driver falls back to the
         device's reported HBM limit)."""
         return parse_size(self.mem_budget)
+
+    def stream_budget_bytes(self) -> int:
+        """-stream-budget in bytes (0 = unset; no in-core gate)."""
+        return parse_size(self.stream_budget)
 
     def exchange_mode(self) -> str:
         """Effective exchange mode ('halo' | 'allgather' | 'ring')."""
@@ -334,6 +369,15 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-mem-budget", dest="mem_budget", default="",
                    help="per-device HBM budget for -mem-plan auto "
                         "(e.g. 6g, 512m)")
+    p.add_argument("-stream", action="store_true",
+                   help="out-of-core host-streaming executor: shards "
+                        "rotate through frozen device slots with "
+                        "double-buffered prefetch (roc_tpu/stream)")
+    p.add_argument("-stream-slots", dest="stream_slots", type=int,
+                   default=2, help="prefetch ring depth (default 2)")
+    p.add_argument("-stream-budget", dest="stream_budget", default="",
+                   help="aggregate device-memory budget the in-core path "
+                        "is held to (e.g. 8g); larger graphs must -stream")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

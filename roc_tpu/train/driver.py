@@ -351,6 +351,9 @@ class BaseTrainer:
             rec["param_norm"] = float(vals["param_norm"])
             rec["wire_bytes"] = int(vals["wire_bytes"])
             rec["edges_per_shard"] = [int(e) for e in vals["edges"]]
+        extra = self._obs_epoch_extra(epoch)
+        if extra:
+            rec.update(extra)
         self._metrics.emit("metrics", **rec)
         if self.watchdog is not None:
             alert = self.watchdog.observe_epoch(epoch, wall_s)
@@ -361,6 +364,23 @@ class BaseTrainer:
                              f"{alert['ratio']:.2f}x the EWMA "
                              f"({alert['wall_s'] * 1e3:.1f} ms vs "
                              f"{alert['ewma_s'] * 1e3:.1f} ms)")
+            if extra and "stream_stall_frac" in extra:
+                alert = self.watchdog.observe_stream(
+                    epoch, extra["stream_stall_frac"])
+                if alert is not None:
+                    self._metrics.emit("watchdog", **alert)
+                    if self.config.verbose:
+                        print_fn(
+                            f"# watchdog: epoch {epoch} stream stall "
+                            f"fraction {alert['stall_frac']:.3f} is "
+                            f"{alert['ratio']:.2f}x its EWMA "
+                            f"({alert['ewma']:.3f})")
+
+    def _obs_epoch_extra(self, epoch):
+        """Executor-specific per-epoch obs fields (the stream executor
+        reports stall/overlap here); merged into the unified record."""
+        del epoch
+        return None
 
     def _obs_finish(self, stats: "TrainStats", print_fn):
         """End-of-train summary record + artifact export (trace.json /
@@ -395,9 +415,9 @@ class BaseTrainer:
         budget = cfg.mem_budget_bytes()
         if cfg.mem_plan == "auto" and budget == 0:
             budget = memory.device_budget_bytes()
-        self.mem_plan = memory.plan_memory(self.mem_estimate,
-                                           mode=cfg.mem_plan,
-                                           budget_bytes=budget)
+        self.mem_plan = memory.plan_memory(
+            self.mem_estimate, mode=cfg.mem_plan, budget_bytes=budget,
+            offload_executed=getattr(cfg, "stream", False))
         if cfg.verbose and (cfg.mem_plan != "keep" or budget):
             print(f"# {self.mem_plan.summary()}")
 
@@ -405,7 +425,9 @@ class BaseTrainer:
         """``model.loss`` with the memory plan's checkpoint policy applied
         (the model's own loss when the plan keeps everything)."""
         from roc_tpu.memory import policy as mem_policy
-        return mem_policy.loss_fn(self.model, getattr(self, "mem_plan", None))
+        return mem_policy.loss_fn(self.model, getattr(self, "mem_plan", None),
+                                  offload_to_host=getattr(
+                                      self.config, "stream", False))
 
     def _peak_hbm(self):
         """(bytes, source) for this epoch's peak HBM: device-reported where
@@ -704,6 +726,23 @@ def make_trainer(config: Config, dataset: Dataset, model: Model) -> BaseTrainer:
     `-check-sharding` and `-analyze` paths, the audit matrix, and bench.py
     go through here so a trainer (and its partition + compiled steps) is
     built exactly once and reused."""
+    if config.stream:
+        from roc_tpu.stream.executor import StreamTrainer
+        return StreamTrainer(config, dataset, model)
+    budget = config.stream_budget_bytes()
+    if budget:
+        from roc_tpu.stream import incore_resident_bytes
+        need = incore_resident_bytes(dataset)
+        if need > budget:
+            # the out-of-core gate: refuse to build an in-core trainer for
+            # a graph whose placed data alone exceeds the device budget
+            def _fmt(b):
+                return (f"{b / 2**20:.0f} MiB" if b >= 2**20
+                        else f"{b / 2**10:.0f} KiB")
+            raise SystemExit(
+                f"error: graph needs ~{_fmt(need)} device-resident "
+                f"but -stream-budget is {_fmt(budget)}; rerun "
+                f"with -stream to rotate shards through host memory")
     if config.num_parts > 1:
         from roc_tpu.parallel.spmd import SpmdTrainer
         return SpmdTrainer(config, dataset, model)

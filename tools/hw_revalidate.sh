@@ -9,7 +9,8 @@
 #   2. products-shape A/B (matmul vs auto-binned vs +reorder)
 #   3. fp32-exact + GAT + overcommit benches
 #   4. TPU-gated kernel tests
-#   5. group-count / constant / sparse-preset sweeps
+#   5. out-of-core streaming A/B (streamed vs in-core + overlap fraction)
+#   6. group-count / constant / sparse-preset sweeps
 # Each step is timeout-guarded so a wedged compile can't eat the window.
 # Usage:  bash tools/hw_revalidate.sh [start-step]  (from repo root)
 set -u
@@ -17,8 +18,8 @@ cd "$(dirname "$0")/.."
 LOG=/tmp/hw_revalidate.log
 START=${1:-0}
 case "$START" in
-    [0-5]) ;;
-    *) echo "usage: $0 [start-step 0-5]" >&2; exit 2 ;;
+    [0-6]) ;;
+    *) echo "usage: $0 [start-step 0-6]" >&2; exit 2 ;;
 esac
 : > "$LOG"
 
@@ -165,17 +166,34 @@ done
 fi
 
 if [ "$START" -le 5 ]; then
-note "5. group-count sweep (fewer groups -> less phase-1 rounding)"
+note "5. out-of-core streaming A/B at the canonical shape: paired legs"
+note "   (in-core SPMD, then ROC_BENCH_STREAM=1 rotating 4 shards through"
+note "   2 device slots).  Record both epoch times and the streamed leg's"
+note "   stream.stream_overlap_frac (the artifact's measured transfer/"
+note "   compute overlap) in docs/PERF.md round 11 — the cost model"
+note "   predicts near-full overlap when per-shard compute exceeds the"
+note "   staging-DMA time of one slot's table bytes"
+ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
+ROC_BENCH_STREAM=1 ROC_STREAM_SLOTS=2 ROC_BENCH_EPOCHS=5 \
+    timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+# driver-path smoke on real hardware: >2x-budget rotation + live obs
+timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
+    -e 10 -parts 4 -stream -stream-slots 2 -v 2>&1 | tail -3 | tee -a "$LOG"
+fi
+
+if [ "$START" -le 6 ]; then
+note "6. group-count sweep (fewer groups -> less phase-1 rounding)"
 for grt in 2097152 4194304 8388608; do
     note "   ROC_BINNED_GROUP_ROWS=$grt"
     ROC_BINNED_GROUP_ROWS=$grt ROC_BENCH_BACKEND=binned \
         timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 done
 
-note "5b. constant sweep round 2"
+note "6b. constant sweep round 2"
 timeout 5400 python tools/sweep_binned.py 2>&1 | tee -a "$LOG"
 
-note "5c. sparse-preset sweep at products shape (re-fit choose_geometry's"
+note "6c. sparse-preset sweep at products shape (re-fit choose_geometry's"
 note "    cost model constants from whatever this measures)"
 SWEEP_SHAPE=products SWEEP_N=2449029 SWEEP_E=125000000 SWEEP_TIMEOUT_S=1800 \
     timeout 6000 python tools/sweep_binned.py 2>&1 | tee -a "$LOG"

@@ -59,6 +59,16 @@ cmp -s "$PLAN_A" "$PLAN_B" || {
     diff "$PLAN_A" "$PLAN_B" >&2; rm -f "$PLAN_A" "$PLAN_B"; exit 1; }
 rm -f "$PLAN_A" "$PLAN_B"
 
+# Streamed smoke: the out-of-core executor must still train end-to-end
+# (tiny graph, 2 shards through 2 slots).  This is the cheapest proof that
+# slot rotation, the prefetch ring, and the host-side gradient scatter all
+# still compose — unit tests cover the pieces, this covers the wiring.
+echo "== streamed smoke =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m roc_tpu \
+    -dataset roc-audit -layers 8-16-4 -e 2 -parts 2 \
+    -stream -stream-slots 2 -eval-every 100 >/dev/null || {
+    echo "preflight: streamed smoke RED" >&2; exit 1; }
+
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
