@@ -172,8 +172,14 @@ DTYPE = "bf16" if os.environ.get("ROC_BF16_STORAGE") == "1" else "fp32"
 # storage: every artifact is stamped with the fusion level, mega legs
 # annotate the metric and are excluded from vs_baseline and the
 # last-known-good persist — the reference figures are two-pass numbers,
-# and the fused program is a different trace.
-FUSION = "mega" if os.environ.get("ROC_MEGAFUSE") == "1" else "none"
+# and the fused program is a different trace.  Since round 12 the fused
+# VJP is on by default under -megafuse, so the stamp distinguishes
+# "mega+bwd" (forward + fused backward) from "mega" (forward-only:
+# ROC_MEGA_BWD=0 kill switch) — hw_revalidate step 4c's three legs.
+FUSION = "none"
+if os.environ.get("ROC_MEGAFUSE") == "1":
+    FUSION = "mega" if os.environ.get("ROC_MEGA_BWD", "") == "0" \
+        else "mega+bwd"
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -613,6 +619,12 @@ def run():
                 "step_delta_vs_remat": round(
                     plan.predicted_step_s / remat.predicted_step_s - 1, 4),
             }
+            if FUSION == "mega+bwd":
+                # predicted backward-intermediate HBM the fused VJP skips
+                # (the [rows, H_in] cotangent round trip per fused layer)
+                from roc_tpu.memory.estimator import mega_bwd_cotangent_drop
+                mem["mega_bwd_cotangent_drop_bytes"] = \
+                    mega_bwd_cotangent_drop(trainer.model, est.rows)
         if plan is not None and plan.any_offload():
             # bench legs must not claim host offload before the streaming
             # executor is the one running: an OFFLOAD verdict lowered by the

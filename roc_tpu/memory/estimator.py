@@ -123,18 +123,20 @@ def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
     state, placed node tensors) the caller already knows.
 
     ``megafuse=True`` applies the whole-layer megakernel's tensor
-    elimination: for every ``mega_matches`` pair the aggregate's output
-    (and the linear's, when a trailing relu folds in) never materializes,
-    so those tensors contribute zero to ``bytes_full``/``bytes_saved`` and
-    the DP plans over the fused layer's real residual set.
+    elimination: every ``mega_matches`` record names the output tensors
+    that never materialize under fusion in its ``gone`` tuple — the
+    aggregate's output (and the linear's, when a trailing relu folds in)
+    for the direct chain; the linear's, aggregate's, and second norm's
+    for the norm-folded GCN chain (the first norm's output stays counted
+    as the proxy for the pre-scaled input the folded path materializes
+    instead).  Those contribute zero to ``bytes_full``/``bytes_saved``
+    and the DP plans over the fused layer's real residual set.
     """
     fused_gone: set = set()
     if megafuse:
         from roc_tpu.models.model import mega_matches
         for rec in mega_matches(model).values():
-            fused_gone.add(rec["aggregate"].out)
-            if rec["final"] is not rec["linear"]:
-                fused_gone.add(rec["linear"].out)
+            fused_gone.update(rec["gone"])
     dims = _op_out_dims(model)
     per_layer: Dict[int, List] = {}
     for op in model.ops:
@@ -170,6 +172,20 @@ def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
     # aggregate is one transposed aggregation + accumulation)
     return ModelEstimate(layers=tuple(layers), fixed_bytes=int(fixed_bytes),
                          base_step_s=3.0 * total_fwd, rows=rows, edges=edges)
+
+
+def mega_bwd_cotangent_drop(model, rows: int, itemsize: int = 4) -> int:
+    """Predicted backward-intermediate HBM bytes the fused megakernel
+    BACKWARD eliminates: per ``mega_matches`` layer, the ``[rows, H_in]``
+    aggregation cotangent (dL/dagg = g @ W^T) no longer round-trips HBM —
+    one write + one read each (see ``binned.predicted_trainstep_hbm_bytes``
+    for the full train-step accounting this slots into).  bench.py reports
+    this in the mem artifact block on fused-backward legs."""
+    from roc_tpu.models.model import mega_matches
+    total = 0
+    for rec in mega_matches(model).values():
+        total += 2 * rows * rec["linear"].attrs["in_dim"] * itemsize
+    return total
 
 
 def fixed_bytes_for(model, rows: int, in_dim: int, num_classes: int,

@@ -48,12 +48,14 @@ class GraphCtx(NamedTuple):
     # -> [N, K, F]; built by the same driver/spmd code that builds
     # ``aggregate`` (it owns the halo/all_gather exchange).
     attend: Optional[Callable] = None
-    # whole-layer megakernel hook: (x, w, activation, aggr) -> out or None.
-    # When set, `apply` offers each `mega_matches`-eligible
-    # aggregate→linear(→relu) pair to it; a None return means "not fusable
-    # here" (VMEM gate, hybrid plan, kill switch) and the unfused op
-    # sequence runs unchanged.  Default None keeps every existing program
-    # byte-identical — the HLO budget audit pins that.
+    # whole-layer megakernel hook:
+    # (x, w, activation, aggr, fold) -> out or None.
+    # When set, `apply` offers each `mega_matches`-eligible chain to it —
+    # aggregate→linear(→relu) directly, or the norm-folded GCN shape when
+    # fold=True (the hook owns the D^-1/2 pre/post scales); a None return
+    # means "not fusable here" (VMEM gate, hybrid plan, kill switch) and
+    # the unfused op sequence runs unchanged.  Default None keeps every
+    # existing program byte-identical — the HLO budget audit pins that.
     fuse_linear: Optional[Callable] = None
 
 
@@ -73,60 +75,107 @@ class OpNode:
 
 
 def mega_matches(model: "Model") -> Dict[int, dict]:
-    """Find megakernel-eligible ``aggregate → linear (→ relu)`` chains.
+    """Find megakernel-eligible layer chains in the static op IR.
 
-    Returns ``{op_index_of_aggregate: record}`` where record carries the
-    matched ``linear`` node, the resolved activation ("none"/"relu"), the
-    ``final`` node whose output tensor (and ckpt tag) the fused op takes
-    over, and the op indices to ``skip`` when fusion succeeds.
+    Two shapes match.  The direct ``aggregate → linear (→ relu)`` chain
+    (GIN/SAGE) is keyed by the AGGREGATE's op index.  The GCN chain
+    ``linear → norm → aggregate → norm (→ relu)`` is keyed by the
+    LINEAR's op index and carries ``fold=True`` (round 12, norm-folding):
+    since ``indegree_norm`` is a positive diagonal row-scale,
+    D^-½ A D^-½ (xW) = D^-½ · A · ((D^-½ x) W) — the hook pre-scales the
+    layer input, runs the same fused aggregate→linear kernel, and
+    post-scales; relu commutes with the positive scale, so the in-kernel
+    epilogue still applies (bitwise: relu(c·v) = c·relu(v) picks the
+    identical product).  Note the folded forward reassociates the scale
+    through the GEMM — logits parity vs unfused is ≤1e-3-tight, not
+    bitwise (tests/test_mega_bwd.py pins 3-epoch parity).
 
-    Eligibility — all structural, decided from the static op IR:
+    Each record carries the matched ``aggregate``/``linear`` nodes, the
+    resolved activation ("none"/"relu"), ``final`` (the node whose output
+    tensor and ckpt tag the fused op takes over), the op indices to
+    ``skip`` when fusion succeeds, ``fold``, and ``gone`` — the output
+    tensor ids that never materialize under fusion (the memory
+    estimator's accounting input).  Folded ``gone`` excludes the first
+    norm's output deliberately: the hook materializes the pre-scaled
+    input z = D^-½ x at exactly that shape, so dropping it would
+    overstate the win.
 
-    * the aggregate is sum or avg and its output feeds exactly one op,
-      a ``linear`` (so skipping the intermediate drops no other use and
-      the ``[rows, H_in]`` aggregate never needs to materialize);
-    * the linear's activation is none or relu (the kernel's in-register
-      epilogue); a separate single-consumer relu node directly after an
-      activation-free linear is folded in the same way;
-    * everything sits in the same builder layer, so fusion never crosses
-      an ``end_layer`` checkpoint boundary and the memory planner's
-      per-layer accounting stays well-formed;
-    * no matched intermediate is the logits tensor.
-
-    GIN/SAGE layers match; GCN's ``linear → norm → aggregate → norm``
-    shape does not (the aggregate feeds a norm, not a linear) — its win
-    needs norm-folding, a separate item.
+    Eligibility — all structural: every intermediate feeds exactly one
+    op, the whole chain sits in one builder layer (fusion never crosses
+    an ``end_layer`` checkpoint boundary), the aggregate is sum or avg,
+    the linear's own activation is none or relu (none for the folded
+    shape — GCN's recipe never fuses one), a trailing single-consumer
+    relu folds into the epilogue, and no interior intermediate is the
+    logits tensor.
     """
     consumers: Dict[int, List[int]] = {}
     for i, op in enumerate(model.ops):
         for t in op.inputs:
             consumers.setdefault(t, []).append(i)
     logits_id = model.logits.id if model.logits is not None else -1
+
+    def sole(out_id, layer):
+        """The single same-layer consumer of tensor ``out_id``, or None."""
+        cons = consumers.get(out_id, [])
+        if len(cons) != 1:
+            return None, -1
+        nxt = model.ops[cons[0]]
+        if nxt.attrs.get("layer") != layer:
+            return None, -1
+        return nxt, cons[0]
+
     found: Dict[int, dict] = {}
     for i, op in enumerate(model.ops):
         if op.kind != "aggregate" or op.attrs.get("aggr") not in ("sum",
                                                                   "avg"):
             continue
-        cons = consumers.get(op.out, [])
-        if len(cons) != 1 or op.out == logits_id:
+        if op.out == logits_id:
             continue
-        lin = model.ops[cons[0]]
-        if (lin.kind != "linear"
-                or lin.attrs.get("activation") not in ("none", "relu")
-                or lin.attrs.get("layer") != op.attrs.get("layer")):
+        layer = op.attrs.get("layer")
+        lin, li = sole(op.out, layer)
+        if (lin is None or lin.kind != "linear"
+                or lin.attrs.get("activation") not in ("none", "relu")):
             continue
-        activation, skip, final = lin.attrs["activation"], [cons[0]], lin
+        activation, skip, final = lin.attrs["activation"], [li], lin
         if activation == "none" and lin.out != logits_id:
-            lcons = consumers.get(lin.out, [])
-            nxt = model.ops[lcons[0]] if len(lcons) == 1 else None
+            nxt, ni = sole(lin.out, layer)
             if (nxt is not None and nxt.kind == "activation"
-                    and nxt.attrs.get("mode") == "relu"
-                    and nxt.attrs.get("layer") == lin.attrs.get("layer")):
+                    and nxt.attrs.get("mode") == "relu"):
                 activation, final = "relu", nxt
-                skip.append(lcons[0])
+                skip.append(ni)
         found[i] = {"aggregate": op, "linear": lin,
                     "activation": activation, "final": final,
-                    "skip": tuple(skip)}
+                    "skip": tuple(skip), "fold": False,
+                    "gone": (op.out,) + ((lin.out,)
+                                         if final is not lin else ())}
+    for i, op in enumerate(model.ops):
+        if (op.kind != "linear" or op.attrs.get("activation") != "none"
+                or op.out == logits_id):
+            continue
+        layer = op.attrs.get("layer")
+        n1, i1 = sole(op.out, layer)
+        if n1 is None or n1.kind != "norm" or n1.out == logits_id:
+            continue
+        agg, ia = sole(n1.out, layer)
+        if (agg is None or agg.kind != "aggregate"
+                or agg.attrs.get("aggr") not in ("sum", "avg")
+                or agg.out == logits_id):
+            continue
+        n2, i2 = sole(agg.out, layer)
+        if n2 is None or n2.kind != "norm":
+            continue
+        activation, skip, final = "none", [i1, ia, i2], n2
+        if n2.out != logits_id:
+            nxt, ni = sole(n2.out, layer)
+            if (nxt is not None and nxt.kind == "activation"
+                    and nxt.attrs.get("mode") == "relu"):
+                activation, final = "relu", nxt
+                skip.append(ni)
+        found[i] = {"aggregate": agg, "linear": op,
+                    "activation": activation, "final": final,
+                    "skip": tuple(skip), "fold": True,
+                    "gone": (op.out, agg.out) + ((n2.out,)
+                                                 if final is not n2 else ())}
     return found
 
 
@@ -286,7 +335,8 @@ class Model:
                 m = matches[idx]
                 fused = gctx.fuse_linear(
                     a, params[m["linear"].attrs["param"]],
-                    m["activation"], op.attrs["aggr"])
+                    m["activation"], m["aggregate"].attrs["aggr"],
+                    m["fold"])
                 if fused is not None:
                     if ckpt_names:
                         fused = _checkpoint_name(fused,

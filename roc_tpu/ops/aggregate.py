@@ -353,9 +353,10 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
     verdict, or an explicit caller) splits the edges: the binned pair
     covers only the dense-cell edges and ``mm`` carries the rest.
 
-    ``fuse_linear`` applies the megakernel's layer-handoff pricing to the
-    FORWARD direction's auto-choice only (the backward plan runs the plain
-    transposed aggregation; its grad matmuls are separate either way).
+    ``fuse_linear`` applies the megakernel's layer-handoff pricing to BOTH
+    directions' auto-choice (round 12): the backward plan now carries the
+    fused-backward schedule (u = A^T g and dx = u @ W^T in one grid), so
+    its round-trip credit prices the same way the forward's does.
 
     ROC_BINNED_GEOM=<preset name> (binned.GEOM_PRESETS) overrides the
     forward auto-choice for hardware A/B runs that must isolate one
@@ -393,7 +394,9 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
             o = np.argsort(td, kind="stable")   # chunk plans want dst-sorted
             mm = build_aggregate_plans(ts[o], td[o], num_rows, table_rows)
             es, ed = es[keep], ed[keep]
-    bwd_geom = pick(bwd_spec, ed, es, table_rows, num_rows)
+    bwd_geom = pick(bwd_spec, ed, es, table_rows, num_rows,
+                    fuse=fuse_linear,
+                    forced=os.environ.get("ROC_BINNED_GEOM", ""))
     if getattr(bwd_geom, "hub_minc", 0):
         # the split happened (once) on the forward cells; the bwd binned
         # plan covers exactly the transposed dense edges
@@ -521,14 +524,19 @@ def scatter_gather_linear_binned(x, w, plans: BinnedPlans,
     plan's fused schedule and the VMEM gate allow it, else the identical
     two-pass composition.  Differentiable w.r.t. x and w.
 
-    Backward reuses the two-pass path by construction: the VJP replays
-    ``scatter_gather_binned`` -> ``ops.linear`` under jax.vjp, so the
-    gradient program (plans.bwd transposed aggregation, the linear's
-    three GEMMs) is bitwise the one the unfused layer would have run —
-    no fused backward to validate, and the megakernel stays a pure
-    forward-bandwidth optimization.  Hybrid plans (plans.mm) are not
-    eligible: their matmul side adds outside the kernel, so callers
-    route those through the unfused ops."""
+    Backward (round 12) fuses too when ``run_binned_linear_bwd`` admits
+    the transposed plan: one Pallas grid computes u = A^T(g * relu_mask)
+    and dx = u @ W^T, so the ``[rows, H]`` aggregation cotangent never
+    round-trips HBM, and dW = x^T u finishes as a single XLA GEMM (no
+    forward recompute: (Ax)^T g = x^T A^T g).  When the fused backward
+    declines (VMEM gate, non-flat bwd geometry, ROC_MEGA_BWD=0), the VJP
+    replays ``scatter_gather_binned`` -> ``ops.linear`` under jax.vjp —
+    byte-identical to the gradient program the unfused layer would have
+    run, and the bitwise oracle the fused path is tested against on
+    integer data (tests/test_mega_bwd.py; fp32 reassociates within a
+    documented ULP bound).  Hybrid plans (plans.mm) are not eligible:
+    their matmul side adds outside the kernel, so callers route those
+    through the unfused ops."""
     from roc_tpu.ops.pallas.binned import run_binned_linear
     assert plans.mm is None, \
         "megakernel fusion requires a pure binned plan (no hybrid side)"
@@ -537,18 +545,33 @@ def scatter_gather_linear_binned(x, w, plans: BinnedPlans,
 
 
 def _bnl_fwd(x, w, plans, interpret, precision, activation):
-    return scatter_gather_linear_binned(
-        x, w, plans, interpret, precision, activation), (x, w, plans)
+    out = scatter_gather_linear_binned(
+        x, w, plans, interpret, precision, activation)
+    # the saved output is the relu-mask source for the fused backward;
+    # for activation="none" it rides the residuals unused (same buffer
+    # the caller holds anyway — no extra liveness)
+    return out, (x, w, plans, out)
 
 
 def _bnl_bwd(interpret, precision, activation, res, g):
-    x, w, plans = res
+    x, w, plans, out = res
+    from roc_tpu.ops.pallas.binned import run_binned_linear_bwd
+    fused = run_binned_linear_bwd(g, out, w, plans.bwd, interpret,
+                                  precision, relu=(activation == "relu"))
+    zero = jax.tree.map(
+        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
+    if fused is not None:
+        u, dx = fused
+        # dW = x^T u as one XLA GEMM (matches ops.linear's grad precision)
+        gw = jax.lax.dot_general(
+            x.astype(jnp.float32), u, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32).astype(w.dtype)
+        return dx.astype(x.dtype), gw, zero
     _, vjp = jax.vjp(
         lambda xx, ww: _unfused_layer(xx, ww, plans, interpret, precision,
                                       activation), x, w)
     gx, gw = vjp(g)
-    zero = jax.tree.map(
-        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
     return gx, gw, zero
 
 

@@ -709,10 +709,12 @@ def fused_plan_steps(cell_blk: np.ndarray, cell_bin: np.ndarray,
 
 def _fused_sched_stats(cell_blk, cell_bin, cnt, geom, num_rows, table_rows,
                        num_edges):
-    """(fused_steps, C2) for these cells, or None when no fused schedule
+    """(fused_steps, C2, G) for these cells, or None when no fused schedule
     attaches — the shared arithmetic behind fused_plan_steps and the
-    kernel-budget tool's megakernel row (which also needs C2 to evaluate
-    _mega_vmem_ok offline)."""
+    kernel-budget tool's megakernel rows (which also need C2 and the group
+    count to evaluate _mega_vmem_ok/_mega_bwd_vmem_ok offline: a
+    single-group plan stages on ONE parity, halving the dominant VMEM
+    term)."""
     if not (geom.flat and geom.ch == geom.ch2):
         return None
     num_bins = max(-(-num_rows // geom.rb), 1)
@@ -738,7 +740,7 @@ def _fused_sched_stats(cell_blk, cell_bin, cnt, geom, num_rows, table_rows,
     if C2 * geom.ch2 > _FUSE_MAX_STG_ROWS:
         return None
     steps = _pad_to(max(int(c1_per_g.sum()) + int(bin_chunks.sum()), 1), 8)
-    return steps, C2
+    return steps, C2, G
 
 
 def predicted_layer_hbm_bytes(num_rows: int, h_in: int, h_out: int,
@@ -755,6 +757,37 @@ def predicted_layer_hbm_bytes(num_rows: int, h_in: int, h_out: int,
     if mega:
         return out
     return out + 2 * num_rows * h_in * itemsize
+
+
+def predicted_trainstep_hbm_bytes(num_rows: int, h_in: int, h_out: int,
+                                  mega_bwd: bool = False,
+                                  itemsize: int = 4) -> int:
+    """Per-layer TRAIN-STEP HBM bytes of the aggregate->linear handoff
+    intermediates: the fused forward (predicted_layer_hbm_bytes with
+    mega=True) plus the backward pass's handoff traffic, in the same
+    scope — OUTSIDE the x-block streaming and staging both backward modes
+    share.
+
+    ``mega_bwd=False`` is the two-pass VJP replay: the backward re-reads
+    x (one [rows, h_in]), recomputes the aggregate (write + the replayed
+    linear's read + the dW pass's read = 3x [rows, h_in]) and the output
+    (write + relu-mask read = 2x [rows, h_out]), then materializes the
+    dagg cotangent ([rows, h_in] write + backward-aggregation read) —
+    6 h_in + 2 h_out row trips.  ``mega_bwd=True`` is the fused backward:
+    it writes only u = A^T g ([rows, h_out], read back once by the XLA dW
+    GEMM) and re-reads the saved forward output for the in-kernel relu
+    mask — 3 h_out trips; dx rides the same kernel.  The replay's own
+    recompute staging round trip is NOT counted (the forward's staging is
+    shared, the recompute's is not), so the claimed drop is conservative.
+    The >=2x drop at the Reddit shape is pinned by the CI-gated
+    ``megakernel_bwd`` kernel-budget row (tools/check_kernel_budgets.py)
+    and tests/test_mega_bwd.py."""
+    fwd = predicted_layer_hbm_bytes(num_rows, h_in, h_out, mega=True,
+                                    itemsize=itemsize)
+    if mega_bwd:
+        return fwd + 3 * num_rows * h_out * itemsize
+    return (fwd + 6 * num_rows * h_in * itemsize
+            + 2 * num_rows * h_out * itemsize)
 
 
 def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,
@@ -822,7 +855,12 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     _CHUNK_OVERHEAD_S per 512-row output window — the same currency the
     kernel-budget mega gate uses).  A mega-eligible candidate is instead
     priced at its FUSED schedule: real chunks only, the W matmul riding
-    the existing steps, no second pass.  VMEM admission is NOT checked
+    the existing steps, no second pass.  The same pricing applies to BOTH
+    plan directions since round 12: build_binned_plans passes
+    ``fuse_linear`` through to the backward pick too, so the transposed
+    plan's geometry is chosen knowing the fused backward elides the dagg
+    cotangent's round trip the same way the forward elides the
+    aggregate's.  VMEM admission is NOT checked
     here (H is unknown until trace time; the kernel's own gate falls back
     to the two-pass flat schedule, which this candidate also runs well)."""
     E = len(edge_src)
@@ -2092,11 +2130,11 @@ def _mega_kernel(blk_ref, blk2_ref, obi_ref, last_ref, meta_ref, dsrc_ref,
 
 
 @partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
-                                   "exact", "geom", "relu"))
+                                   "exact", "geom", "relu", "nparity"))
 def _mega_run(x, w, blk, blk2, obi, last, meta, dsrc, ddst, rows,
               nsteps: int, c2: int, out_rows: int, interpret: bool = False,
               exact: bool = False, geom: Geometry = None,
-              relu: bool = False):
+              relu: bool = False, nparity: int = 2):
     H = x.shape[-1]
     Ho = w.shape[-1]
     CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
@@ -2118,8 +2156,12 @@ def _mega_run(x, w, blk, blk2, obi, last, meta, dsrc, ddst, rows,
             pl.BlockSpec((H, Ho), lambda c, b, b2, o, l: (0, 0)),
         ],
         out_specs=pl.BlockSpec((RB, Ho), lambda c, b, b2, o, l: (o[c], 0)),
+        # Single-group plans stage on ONE parity (every step's meta parity
+        # is g%2 == 0, pads included — _attach_fused), so the second
+        # stgbuf parity would be dead VMEM; dropping it is what admits
+        # C2>1 fp32 fusion at the mega-shard shape (round 12).
         scratch_shapes=[pltpu.VMEM((CH, H), staging_dtype(geom, exact)),
-                        pltpu.VMEM((2, srows, H),
+                        pltpu.VMEM((nparity, srows, H),
                                    staging_dtype(geom, exact)),
                         pltpu.SemaphoreType.DMA((1,))],
     )
@@ -2131,15 +2173,25 @@ def _mega_run(x, w, blk, blk2, obi, last, meta, dsrc, ddst, rows,
     )(blk, blk2, obi, last, meta, dsrc, ddst, rows, x, x, w)
 
 
-def _mega_vmem_ok(geom: Geometry, Hp: int, Ho_p: int, c2: int) -> bool:
+def _mega_vmem_ok(geom: Geometry, Hp: int, Ho_p: int, c2: int,
+                  groups: int = 2) -> bool:
     """_fused_vmem_ok extended with the megakernel's extra residents: the
     [Hp, Ho_p] weight tile, the per-chunk [rb, Hp] aggregate tile the dot
     produces, and the [rb, Ho_p] post-linear out window (replacing the
     fused kernel's [rb, Hp] one).  An oversized H_out fails here and
-    run_binned_linear falls back to two-pass aggregate + XLA linear."""
+    run_binned_linear falls back to two-pass aggregate + XLA linear.
+
+    ``groups`` is the plan's group count G: a single-group plan stages on
+    ONE parity (the schedule's parity is g%2 == 0 on every step, pads
+    included), so only one srows*Hp staging buffer is resident — the
+    round-12 admission raise that lets fp32 fuse at C2>1 (the mega-shard
+    shape fits at C2=3 single-parity where double-parity busts the
+    budget).  The default groups=2 is the conservative double-parity
+    charge for callers that don't know G."""
     srows = c2 * geom.ch2
     stg = staging_itemsize(geom, False)
-    need = (2 * srows * Hp * stg + geom.ch * Hp * stg
+    nparity = 1 if groups == 1 else 2
+    need = (nparity * srows * Hp * stg + geom.ch * Hp * stg
             + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
             + 2 * geom.sb * Hp * 4
             + Hp * Ho_p * 4              # resident weight tile
@@ -2197,13 +2249,13 @@ def run_binned_linear(x, w, plan: BinnedPlan, interpret: bool = False,
     Hp = _pad_to(H, 128)
     Ho_p = _pad_to(Ho, 128)
     C2 = plan.p2_obi.shape[1]
+    G = plan.p1_blk.shape[0]
     if (geom.flat and plan.f_meta is not None
             and plan.f_last is not None
             and not (exact and geom.unit == 16)
             and not os.environ.get("ROC_BINNED_NO_FUSE")
             and not megafuse_killed()
-            and _mega_vmem_ok(geom, Hp, Ho_p, C2)):
-        G = plan.p1_blk.shape[0]
+            and _mega_vmem_ok(geom, Hp, Ho_p, C2, groups=G)):
         out_rows = G * plan.bins_per_group * geom.rb
         xp = jnp.pad(x, ((0, _pad_to(plan.table_rows, geom.sb)
                           - x.shape[0]), (0, Hp - H)))
@@ -2217,11 +2269,291 @@ def run_binned_linear(x, w, plan: BinnedPlan, interpret: bool = False,
                             plan.f_last, plan.f_meta, plan.f_dsrc,
                             plan.f_ddst, plan.f_rows, S, C2, out_rows,
                             interpret, exact, geom,
-                            activation == "relu")
+                            activation == "relu",
+                            1 if G == 1 else 2)
         return out[:plan.num_rows, :Ho].astype(x.dtype)
     # VMEM-gate / kill-switch fallback: the identical two-pass layer
     from roc_tpu.ops.linear import linear
     return linear(run_binned(x, plan, interpret, precision), w, activation)
+
+
+# ---------------------------------------------------------------------------
+# Megakernel BACKWARD (round 12): the layer's whole cotangent pipeline —
+# relu mask, transposed aggregation u = A^T g, and dx = u @ W^T — in one
+# Pallas grid over the TRANSPOSED (plans.bwd) flat schedule.  dW = x^T u
+# stays an XLA GEMM outside (it needs x, which the kernel never streams).
+# ---------------------------------------------------------------------------
+
+# ROC_MEGA_BWD=0 kill switch for the FUSED BACKWARD only (the forward
+# megakernel keeps running): gradients fall back to the two-pass VJP
+# replay — today's bitwise-gradient behavior, byte for byte.  Warn-once
+# like megafuse_killed: flipping it changes the backward program.
+_MEGA_BWD_KILL_WARNED = [False]
+
+
+def mega_bwd_killed() -> bool:
+    """True when ROC_MEGA_BWD=0 disables the fused megakernel backward at
+    runtime (checked at every VJP dispatch; warn-once)."""
+    if os.environ.get("ROC_MEGA_BWD", "") != "0":
+        return False
+    if not _MEGA_BWD_KILL_WARNED[0]:
+        _MEGA_BWD_KILL_WARNED[0] = True
+        warnings.warn(
+            "ROC_MEGA_BWD=0: fused megakernel backward disabled; "
+            "eligible layers' gradients replay the two-pass "
+            "aggregate+linear composition instead.", stacklevel=2)
+    return True
+
+
+def _mega_bwd_vmem_ok(geom: Geometry, Ho_p: int, Hi_p: int, c2: int,
+                      groups: int = 2, relu: bool = False) -> bool:
+    """Trace-time admission for the backward megakernel.  Mirrors
+    _mega_vmem_ok at the backward's widths — staging/gbuf/one-hots ride
+    the OUTPUT width Ho_p (the cotangent is what aggregates) — plus the
+    backward's own residents: the relu path streams TWO extra saved-output
+    blocks alongside the cotangent blocks, the transposed [Ho_p, Hi_p]
+    weight tile sits where the forward's [Hp, Ho_p] one did, and BOTH
+    output windows (u at Ho_p, dx at Hi_p) are resident per bin."""
+    srows = c2 * geom.ch2
+    stg = staging_itemsize(geom, False)
+    nparity = 1 if groups == 1 else 2
+    need = (nparity * srows * Ho_p * stg + geom.ch * Ho_p * stg
+            + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
+            + (4 if relu else 2) * geom.sb * Ho_p * 4
+            + Ho_p * Hi_p * 4            # resident W^T tile
+            + geom.rb * Ho_p * 4         # per-chunk cotangent tile
+            + geom.rb * Ho_p * 4         # u out window
+            + geom.rb * Hi_p * 4)        # dx out window
+    return need <= _VMEM_BUDGET
+
+
+def _mega_bwd_kernel(*args, exact: bool = False, geom: Geometry = None,
+                     relu: bool = False):
+    """Backward twin of _mega_kernel over the transposed plan.  Kind 0
+    expands a chunk of the OUTPUT cotangent g — masked in-register by the
+    saved forward output when the layer fused a relu (mask before the
+    one-hot: it is per-source-row, and pad rows carry y=0 so they stay
+    zero) — and stages it; kind 1 scatter-adds one staging chunk into the
+    per-bin cotangent tile u_tile = (A^T g_masked)[bin], accumulates it
+    into the u window (written to HBM for the XLA dW GEMM: dW = x^T u),
+    AND accumulates u_tile @ W^T into the dx window — both outputs ride
+    the same nondecreasing out index, so one grid produces the layer's
+    full input cotangent.  Correct per chunk for the same distributivity
+    reason as the forward (integer data is bit-exact; fp32 reassociates
+    within the documented ULP bound).  No f_last epilogue exists here:
+    the relu mask is a PRE-aggregation operation, applied in kind 0."""
+    if relu:
+        (blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref, ddst_ref,
+         rows_ref, g_ref, g2_ref, y_ref, y2_ref, wt_ref,
+         u_ref, dx_ref, gbuf, stgbuf, sems) = args
+    else:
+        (blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref, ddst_ref,
+         rows_ref, g_ref, g2_ref, wt_ref,
+         u_ref, dx_ref, gbuf, stgbuf, sems) = args
+        y_ref = y2_ref = None
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    U = geom.unit_rows
+    st = staging_dtype(geom, exact)
+    c = pl.program_id(0)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+        sl = rows_ref[:]
+        t1 = (lane == sl).astype(jnp.bfloat16)
+        gv = g_ref[:]
+        if relu:
+            # d/dy relu at the saved output: pass g where y > 0.  At an
+            # exact pre-activation zero this differs from jnp.maximum's
+            # tie-splitting VJP (0.5*g) — measure-zero on continuous
+            # data; docs/DESIGN.md §Megakernel documents the tie rule.
+            gv = jnp.where(y_ref[:] > 0, gv, jnp.zeros_like(gv))
+        gbuf[:] = _onehot_dot(t1, gv, (((1,), (0,)), ((), ())),
+                              exact).astype(st)
+
+        @pl.when(blk2_ref[c] != blk_ref[c])
+        def _():
+            t2 = (lane == sl - SB).astype(jnp.bfloat16)
+            gv2 = g2_ref[:]
+            if relu:
+                gv2 = jnp.where(y2_ref[:] > 0, gv2, jnp.zeros_like(gv2))
+            gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                t2, gv2, (((1,), (0,)), ((), ())), exact)).astype(st)
+
+        def issue(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).start()
+            return 0
+        jax.lax.fori_loop(0, KD, issue, 0)
+
+        def drain(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).wait()
+            return 0
+        jax.lax.fori_loop(0, KD, drain, 0)
+
+    @pl.when(kind == 1)
+    def _():
+        @pl.when(first == 1)
+        def _():
+            u_ref[:] = jnp.zeros_like(u_ref)
+            dx_ref[:] = jnp.zeros_like(dx_ref)
+
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        rows = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        s_t = (lane == dl).astype(jnp.bfloat16)
+        tile = _onehot_dot(s_t, rows, (((0,), (0,)), ((), ())), exact)
+        u_ref[:] += tile
+        dx_ref[:] += jax.lax.dot_general(
+            tile, wt_ref[:], (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
+                                   "exact", "geom", "relu", "nparity"))
+def _mega_bwd_run(g, y, wt, blk, blk2, obi, meta, dsrc, ddst, rows,
+                  nsteps: int, c2: int, out_rows: int,
+                  interpret: bool = False, exact: bool = False,
+                  geom: Geometry = None, relu: bool = False,
+                  nparity: int = 2):
+    Ho = g.shape[-1]
+    Hi = wt.shape[-1]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    srows = c2 * geom.ch2
+    # The saved-output blocks (relu mask source) ride the SAME index maps
+    # as the cotangent blocks: masking happens per source row, before the
+    # one-hot expand.
+    y_specs = [
+        pl.BlockSpec((SB, Ho), lambda c, b, b2, o: (b[c], 0)),
+        pl.BlockSpec((SB, Ho), lambda c, b, b2, o: (b2[c], 0)),
+    ] if relu else []
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,                  # blk, blk2, obi [S]
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((8, 4), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, b, b2, o: (c, 0)),
+            pl.BlockSpec((SB, Ho), lambda c, b, b2, o: (b[c], 0)),
+            pl.BlockSpec((SB, Ho), lambda c, b, b2, o: (b2[c], 0)),
+            *y_specs,
+            # transposed weight tile, constant index: fetched once,
+            # VMEM-resident for the whole grid (the forward's weight
+            # BlockSpec pattern at the transposed shape)
+            pl.BlockSpec((Ho, Hi), lambda c, b, b2, o: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((RB, Ho), lambda c, b, b2, o: (o[c], 0)),
+            pl.BlockSpec((RB, Hi), lambda c, b, b2, o: (o[c], 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((CH, Ho), staging_dtype(geom, exact)),
+                        pltpu.VMEM((nparity, srows, Ho),
+                                   staging_dtype(geom, exact)),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    ins = (blk, blk2, obi, meta, dsrc, ddst, rows, g, g)
+    ins += (y, y) if relu else ()
+    return pl.pallas_call(
+        partial(_mega_bwd_kernel, exact=exact, geom=geom, relu=relu),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((out_rows, Ho), jnp.float32),
+                   jax.ShapeDtypeStruct((out_rows, Hi), jnp.float32)],
+        interpret=interpret,
+    )(*ins, wt)
+
+
+def run_binned_linear_bwd(g, y, w, plan: BinnedPlan,
+                          interpret: bool = False, precision: str = "fast",
+                          relu: bool = False):
+    """Fused backward of the megakernel layer, over the TRANSPOSED plan
+    (ops.aggregate passes plans.bwd): given the output cotangent
+    g [num_rows_fwd, H_out], the saved forward output y (relu mask
+    source; ignored when relu=False) and the layer weight w [H_in, H_out],
+    returns (u, dx) with u = A^T (g * relu_mask) [table_rows_fwd, H_out]
+    and dx = u @ W^T [table_rows_fwd, H_in] — the [rows, H_in] dagg
+    cotangent never reaches HBM.  The caller finishes with the XLA GEMM
+    dW = x^T u.
+
+    Returns None when ANY admission gate fails (non-fused plan, exact on
+    a bf16 unit, ROC_BINNED_NO_FUSE / ROC_NO_MEGAFUSE / ROC_MEGA_BWD=0,
+    or the VMEM budget): the caller must then replay the two-pass
+    composition — which is also the bitwise oracle the fused path is
+    tested against on integer data."""
+    if precision not in ("fast", "exact"):
+        raise ValueError(f"precision={precision!r}: must be 'fast' or "
+                         f"'exact'")
+    exact = precision == "exact" and g.dtype == jnp.float32
+    geom = plan.geom or _default_geom()
+    Ho = g.shape[-1]
+    Hi = w.shape[0]
+    Ho_p = _pad_to(Ho, 128)
+    Hi_p = _pad_to(Hi, 128)
+    C2 = plan.p2_obi.shape[1]
+    G = plan.p1_blk.shape[0]
+    if not (geom.flat and plan.f_meta is not None
+            and plan.f_last is not None
+            and not (exact and geom.unit == 16)
+            and not os.environ.get("ROC_BINNED_NO_FUSE")
+            and not megafuse_killed()
+            and not mega_bwd_killed()
+            and _mega_bwd_vmem_ok(geom, Ho_p, Hi_p, C2, groups=G,
+                                  relu=relu)):
+        return None
+    out_rows = G * plan.bins_per_group * geom.rb
+    rows_pad = _pad_to(plan.table_rows, geom.sb)
+    gp = jnp.pad(g, ((0, rows_pad - g.shape[0]), (0, Ho_p - Ho)))
+    # pad rows carry y=0 -> masked to zero, matching their zero cotangent
+    yp = jnp.pad(y, ((0, rows_pad - y.shape[0]), (0, Ho_p - Ho))) \
+        if relu else None
+    # fp32 W^T, zero-padded: pad H_out rows multiply g's zero pad lanes,
+    # pad H_in lanes are stripped from dx below
+    wtp = jnp.pad(jnp.transpose(w.astype(jnp.float32)),
+                  ((0, Ho_p - Ho), (0, Hi_p - Hi)))
+    S = int(plan.f_blk.shape[0])
+    with jax.named_scope("roc_binned_mega_bwd"):
+        u, dx = _mega_bwd_run(gp, yp, wtp, plan.f_blk, plan.f_blk2,
+                              plan.f_obi, plan.f_meta, plan.f_dsrc,
+                              plan.f_ddst, plan.f_rows, S, C2, out_rows,
+                              interpret, exact, geom, relu,
+                              1 if G == 1 else 2)
+    return u[:plan.num_rows, :Ho], dx[:plan.num_rows, :Hi]
 
 
 # one-shot: the eager path is a silent ~9x dispatch-overhead footgun

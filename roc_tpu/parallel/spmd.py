@@ -99,6 +99,14 @@ class ShardedGraphData:
     # fused path lands, and so mode flips are provably retraces today.
     megafuse: bool = dataclasses.field(default=False,
                                        metadata={"static": True})
+    # Fused megakernel BACKWARD mode (round 12): megafuse minus the
+    # ROC_MEGA_BWD=0 kill switch, captured at shard_graph time.  Same
+    # honesty contract as megafuse — the sharded steps never run the
+    # fused backward today (f_* schedules are stripped at stacking), but
+    # flipping the kill switch between trainer builds must change
+    # tree_structure(gd) so the step cache provably re-traces.
+    mega_bwd: bool = dataclasses.field(default=False,
+                                       metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
@@ -107,7 +115,7 @@ jax.tree_util.register_dataclass(
                  "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans",
                  "plans_local", "plans_remote"],
     meta_fields=["backend", "mode", "precision", "xch_dtype", "xch_round",
-                 "xch_comp", "megafuse"])
+                 "xch_comp", "megafuse", "mega_bwd"])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -663,6 +671,8 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         precision=precision,
         xch_dtype=xch[0], xch_round=xch[1], xch_comp=xch[2],
         megafuse=megafuse,
+        mega_bwd=(megafuse
+                  and os.environ.get("ROC_MEGA_BWD", "") != "0"),
     )
 
 

@@ -18,7 +18,14 @@ streaming analog of the memory planner's REMAT, which is why the planner's
 OFFLOAD verdict compiles to this executor), accumulating parameter
 gradients on device and activation cotangents in host stores via the
 transposed table gather (``np.add.at`` over the same ``[S + P*K]`` table
-index map the forward used).
+index map the forward used).  The scatter itself runs on the prefetch
+ring's worker thread, overlapped with the next shard's compute: the
+single worker serializes scatters against each other (shards share halo
+rows in the cotangent stores) and against the next sweep's fetches, and
+the executor additionally drains pending scatters before any sweep that
+reads ``self._cots`` and before the epoch-end optimizer update.
+``stream_scatter_overlap_frac`` in the epoch stats reports how much of
+that scatter time stayed hidden under compute.
 
 Parity: per-shard loss terms and metric tallies are pure sums
 (ops/softmax.py), so the streamed epoch computes the same loss/gradient as
@@ -157,6 +164,9 @@ class StreamTrainer(BaseTrainer):
         self._keys = None
         self._grad_acc = None
         self._xfer_bytes = 0
+        self._scatter_futs = []
+        self._scatter_s = 0.0
+        self._scatter_wait_s = 0.0
         self._logits_sink = None
         self._epoch_stream = []
         self._last_stream_stats = None
@@ -439,6 +449,36 @@ class StreamTrainer(BaseTrainer):
             if cot is not None:
                 cot[lo:lo + self._S] += np.asarray(arr)
 
+    def _scatter_async(self, seg, i, dt, down):
+        """Queue shard i's cotangent scatter on the ring's worker so the
+        device→host pull and ``np.add.at`` overlap the next shard's
+        compute.  The ``np.asarray`` calls inside the scatter helpers run
+        on the worker, so the consumer never blocks on the d2h copy."""
+        def work():
+            with obs.span("stream_scatter", seg=seg.index, shard=i) as sp:
+                if dt is not None:
+                    self._scatter_table(seg, i, dt)
+                self._scatter_own(seg, i, down)
+            self._scatter_s += sp.dur_s
+        self._scatter_futs.append(self._ring.submit(work))
+
+    def _drain_scatters(self):
+        """Block until queued scatters land; called before any sweep whose
+        fetches read ``self._cots`` and before the epoch-end update.  Only
+        time blocked on still-running scatters counts against overlap;
+        worker exceptions re-raise here either way."""
+        futs, self._scatter_futs = self._scatter_futs, []
+        if not futs:
+            return
+        if not futs[-1].done():
+            with obs.span("stream_scatter_wait", pending=len(futs)) as sp:
+                for f in futs:
+                    f.result()
+            self._scatter_wait_s += sp.dur_s
+        else:
+            for f in futs:
+                f.result()
+
     # -- epoch execution ---------------------------------------------------
 
     def _run_step(self, step_key, alpha):
@@ -446,6 +486,8 @@ class StreamTrainer(BaseTrainer):
         ring = self._ring
         ring.reset_epoch_stats()
         self._xfer_bytes = 0
+        self._scatter_s = 0.0
+        self._scatter_wait_s = 0.0
         self._keys = [jax.random.fold_in(step_key, i) for i in range(P)]
         for c in self._cots.values():
             c[:] = 0.0
@@ -456,7 +498,13 @@ class StreamTrainer(BaseTrainer):
             for k in range(n - 1):
                 self._sweep("fwd", k, self._consume_fwd(k))
             for k in range(n - 1, -1, -1):
+                # the cots this sweep fetches are written by the previous
+                # sweep's scatters; FIFO on the worker already orders them
+                # ahead of this sweep's fetches, the drain makes it explicit
+                # (and surfaces worker exceptions at a defined point)
+                self._drain_scatters()
                 self._sweep("bwd", k, self._consume_bwd(k, loss_parts))
+            self._drain_scatters()
             self.params, self.opt_state = self._update(
                 self.params, self._grad_acc, self.opt_state, alpha)
             loss = jnp.sum(jnp.stack(loss_parts))
@@ -498,21 +546,25 @@ class StreamTrainer(BaseTrainer):
                 dp, dt, down = out
             self._grad_acc = dp if self._grad_acc is None else \
                 _tree_map(jnp.add, self._grad_acc, dp)
-            if dt is not None:
-                self._scatter_table(seg, i, dt)
-            self._scatter_own(seg, i, down)
+            if dt is not None or down:
+                self._scatter_async(seg, i, dt, down)
 
         return consume
 
     def _note_epoch_stats(self, wall_s):
         st = self._ring.epoch_stats()
         wall = max(float(wall_s), 1e-12)
+        scat_overlap = 1.0 - self._scatter_wait_s / max(self._scatter_s,
+                                                        1e-12)
         self._last_stream_stats = {
             "stream_stall_s": round(st["stall_s"], 6),
             "stream_transfer_s": round(st["transfer_s"], 6),
             "stream_overlap_frac": round(st["overlap_frac"], 4),
             "stream_stall_frac": round(min(st["stall_s"] / wall, 1.0), 4),
             "stream_bytes": int(self._xfer_bytes),
+            "stream_scatter_s": round(self._scatter_s, 6),
+            "stream_scatter_overlap_frac": round(
+                min(max(scat_overlap, 0.0), 1.0), 4),
         }
         self._epoch_stream.append(
             dict(self._last_stream_stats, epoch=int(self.epoch)))

@@ -80,6 +80,16 @@ class PrefetchRing:
             return out
         return fut.result()
 
+    def submit(self, fn: Callable[[], Any]) -> Future:
+        """Run side work on the prefetch worker, FIFO-serialized with
+        fetches.  The stream executor uses this for host-side cotangent
+        scatters: a single worker means scatters never race each other on
+        shared halo rows, and any later-submitted fetch that reads the
+        scattered stores runs strictly after them.  Side work does not
+        occupy a ring slot (it is a producer, not a staged transfer), so
+        it never blocks ``ensure`` from queueing the next shard."""
+        return self._pool.submit(fn)
+
     def drain(self) -> None:
         """Drop queued prefetches (end of a sweep: the next sweep's inputs
         depend on stores this sweep has not finished writing)."""

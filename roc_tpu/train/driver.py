@@ -209,11 +209,19 @@ def make_gctx(g: DenseGraphData, num_nodes: int,
             and g.plans.mm is None:
         from roc_tpu.ops.pallas import binned as _B
 
-        def fuse_linear(x, w, activation, aggr):
+        def fuse_linear(x, w, activation, aggr, fold=False):
             # Trace-time legality, all static: a None return makes
             # model.apply run that layer's byte-identical unfused op
             # sequence instead (hybrid plans were excluded above — their
-            # matmul side adds outside any kernel).
+            # matmul side adds outside any kernel).  fold=True is the
+            # norm-folded GCN chain: D^-1/2 A D^-1/2 (xW) =
+            # D^-1/2 (A ((D^-1/2 x) W)), so pre-scale the input, run the
+            # same fused kernel, post-scale — relu commutes with the
+            # positive diagonal scale, so the in-kernel epilogue still
+            # applies on the sum path.  Note the folded GCN layer hands
+            # the kernel the PRE-linear width (x.shape[-1] = H_in, e.g.
+            # 602 at the Reddit shape), which is exactly what the VMEM
+            # gate below prices.
             plan = g.plans.fwd
             geom = plan.geom
             exact = g.precision == "exact" and x.dtype == jnp.float32
@@ -225,16 +233,22 @@ def make_gctx(g: DenseGraphData, num_nodes: int,
                     or not _B._mega_vmem_ok(
                         geom, _B._pad_to(x.shape[-1], 128),
                         _B._pad_to(w.shape[-1], 128),
-                        plan.p2_obi.shape[1])):
+                        plan.p2_obi.shape[1],
+                        groups=plan.p1_blk.shape[0])):
                 return None
+            if fold:
+                x = ops.indegree_norm(x, g.in_degree)
             out = ops.scatter_gather_linear_binned(
                 x, w, g.plans, interp, g.precision,
                 "none" if aggr == "avg" else activation)
             if aggr == "avg":
-                # (D^-1 A) W == D^-1 (A W), and relu commutes with the
-                # positive diagonal scale — divide + activate after the
-                # sum-aggregating kernel
+                # (D^-1 A) W == D^-1 (A W) — divide after the
+                # sum-aggregating kernel; the activation moves outside
+                # with it (it must see the divided values)
                 out = ops.divide_by_degree(out, g.in_degree)
+            if fold:
+                out = ops.indegree_norm(out, g.in_degree)
+            if aggr == "avg":
                 out = ops.apply_activation(out, activation)
             return out
 

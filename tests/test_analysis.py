@@ -227,17 +227,29 @@ HOP = 128
 mega_w_bad = pl.BlockSpec((128, 41), lambda i: (0, i))   # raw H_out: flag
 mega_w_ok = pl.BlockSpec((128, HOP), lambda i: (0, i))   # padded: clean
 mega_acc_ok = pl.BlockSpec((256, HOP), lambda i: (i, 0))
+
+# fused-backward tiles (round 12): _mega_bwd_run's TRANSPOSED weight tile
+# flips the axes, so its lane dim is the 128-padded H_in — the same
+# raw-width bug class in the other position; dx blocks likewise carry
+# H_in on the lane axis while cotangent blocks keep H_out
+HIP = 128
+bwd_wt_bad = pl.BlockSpec((HOP, 41), lambda i: (0, 0))   # raw H_in: flag
+bwd_wt_ok = pl.BlockSpec((HOP, HIP), lambda i: (0, 0))   # padded: clean
+bwd_dx_bad = pl.BlockSpec((256, 41), lambda i: (i, 0))   # raw H_in: flag
+bwd_dx_ok = pl.BlockSpec((256, HIP), lambda i: (i, 0))
+bwd_g_ok = pl.BlockSpec((256, HOP), lambda i: (i, 0))    # cotangent block
 """
 
 
 def test_mosaic_lint_flags_fixture():
     from roc_tpu.analysis import mosaic
     fs = mosaic.lint_source(_MOSAIC_FIXTURE, "<fixture>")
-    assert len(fs) == 4, fs
+    assert len(fs) == 6, fs
     assert all(f.rule == "mosaic-align" for f in fs)
     lines = sorted(f.line for f in fs)
-    # the ds(0,41), two bad BlockSpecs, and the raw-H_out mega weight tile
-    assert lines == [8, 13, 14, 25], fs
+    # the ds(0,41), two bad BlockSpecs, the raw-H_out mega weight tile,
+    # and the raw-H_in transposed weight + dx tiles
+    assert lines == [8, 13, 14, 25, 34, 36], fs
 
 
 def test_mosaic_lint_waiver():
@@ -245,7 +257,7 @@ def test_mosaic_lint_waiver():
     src = _MOSAIC_FIXTURE.replace(
         "# sublane 41 % 8 != 0: flag", "# roclint: allow(mosaic-align)")
     fs = mosaic.lint_source(src, "<fixture>")
-    assert len(fs) == 3 and all(f.line > 8 for f in fs), fs
+    assert len(fs) == 5 and all(f.line > 8 for f in fs), fs
 
 
 def test_mosaic_lint_clean_on_tree():

@@ -6,10 +6,11 @@ Bit-equality tests use INTEGER-valued features, weights, and cotangents
 (same convention as tests/test_binned_flat.py): small integers survive
 bf16 rounding and fp32 summation exactly, so the fused kernel's different
 fp32 add order still produces bit-identical sums, and the `highest`
-precision matmul both paths share is exact on them.  The backward tests
-are bitwise BY CONSTRUCTION: scatter_gather_linear_binned's custom VJP
-replays the unfused two-pass composition, so its gradients are literally
-the same program — the tests pin that contract.
+precision matmul both paths share is exact on them.  Since round 12 the
+VJP fuses too (tests/test_mega_bwd.py owns that coverage); the backward
+tests HERE pin the ROC_MEGA_BWD=0 contract: with the kill switch set,
+scatter_gather_linear_binned's VJP replays the unfused two-pass
+composition, so its gradients are literally the same program.
 
 Relu caveat (documented, not a bug): with avg aggregation the fused op
 runs activation-free and divides/activates outside, so pre-activations
@@ -73,8 +74,10 @@ def _spy_mega_run(monkeypatch):
 # -- op-graph pattern matcher ---------------------------------------------
 
 def test_mega_matches_gin_sage_gcn():
-    """GIN (aggregate->linear+relu) and SAGE (aggregate->linear) match;
-    GCN does not (its aggregate feeds a norm, not a linear)."""
+    """GIN (aggregate->linear+relu) and SAGE (aggregate->linear) match
+    directly; GCN matches via norm-folding (round 12) — its
+    linear->norm->aggregate->norm chain is keyed by the LINEAR with
+    fold=True."""
     gin = mega_matches(build_gin([16, 8, 4], 0.5))
     assert len(gin) == 2
     for rec in gin.values():
@@ -83,10 +86,28 @@ def test_mega_matches_gin_sage_gcn():
         assert rec["activation"] == "relu"   # the linear's own epilogue
         assert rec["final"] is rec["linear"]
         assert rec["skip"]                   # ops the fused op buys out
+        assert rec["fold"] is False
+        assert rec["gone"] == (rec["aggregate"].out,)
     sage = mega_matches(build_sage([16, 8, 4], 0.5))
     assert len(sage) == 2
     assert all(r["activation"] == "none" for r in sage.values())
-    assert mega_matches(build_gcn([16, 8, 4], 0.5)) == {}
+    gcn = mega_matches(build_gcn([16, 8, 4], 0.5))
+    assert len(gcn) == 2                     # both layers fold
+    for rec in gcn.values():
+        assert rec["fold"] is True
+        assert rec["linear"].kind == "linear"
+        assert rec["aggregate"].attrs["aggr"] == "sum"
+        # the folded chain buys out norm1 + aggregate + norm2 (+ relu)
+        assert len(rec["skip"]) >= 3
+        # linear + aggregate outs never materialize; norm1's stays counted
+        # (proxy for the materialized pre-scaled input)
+        gone = set(rec["gone"])
+        assert rec["linear"].out in gone and rec["aggregate"].out in gone
+    hid = [r for r in gcn.values() if r["activation"] == "relu"]
+    last = [r for r in gcn.values() if r["activation"] == "none"]
+    assert len(hid) == 1 and len(last) == 1
+    assert hid[0]["final"].kind == "activation"
+    assert last[0]["final"].kind == "norm"   # logits layer: no relu
 
 
 # -- fused kernel vs two-pass composition ---------------------------------
@@ -119,11 +140,15 @@ def test_mega_fwd_bitwise_vs_twopass(geom, act, monkeypatch):
     np.testing.assert_array_equal(out, oracle)
 
 
-def test_mega_grad_bitwise_vs_unfused():
-    """The custom VJP replays the unfused two-pass composition, so
-    gradients of the fused layer are bitwise those of
+def test_mega_grad_bitwise_vs_unfused(monkeypatch):
+    """ROC_MEGA_BWD=0 contract: with the fused backward killed, the
+    custom VJP replays the unfused two-pass composition, so gradients of
+    the fused layer are bitwise those of
     linear(scatter_gather_binned(x), w) — pinned on integer data with the
-    fused relu active."""
+    fused relu active.  (The fused backward's own parity lives in
+    tests/test_mega_bwd.py.)"""
+    monkeypatch.setenv("ROC_MEGA_BWD", "0")
+    monkeypatch.setattr(B, "_MEGA_BWD_KILL_WARNED", [True])
     n, e, h, ho = 700, 5000, 32, 16
     src, dst, x = _int_graph(n, n, e, h, 7)
     w = _int_w(h, ho, 8)
@@ -241,8 +266,13 @@ def test_model_fuse_hook_none_is_byte_identical():
 def test_driver_megafuse_executes_and_matches(monkeypatch):
     """End-to-end A/B at the mega-shard shape, flat geometry pinned on
     both legs (hw_revalidate step 4c's CPU twin): the -megafuse leg must
-    launch the real megakernel and finish with BIT-identical logits."""
+    launch the real megakernel and finish with BIT-identical logits.
+    ROC_MEGA_BWD=0 keeps the backward on the bitwise replay — the fused
+    backward reassociates grads within ULPs, which training amplifies
+    (its own train-step A/B lives in tests/test_mega_bwd.py)."""
     monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    monkeypatch.setenv("ROC_MEGA_BWD", "0")
+    monkeypatch.setattr(B, "_MEGA_BWD_KILL_WARNED", [True])
     ds = _mega_ds()
     layers = [ds.in_dim, 16, ds.num_classes]
     logits = {}
@@ -302,9 +332,10 @@ def test_fused_plan_steps_match_built_plan():
     plan = B.build_binned_plan(src, dst, n, t, geom=GF)
     assert plan.f_meta is not None
     cb, cn, cnt = B._cell_stats(src, dst, GF.sb, GF.rb)
-    steps, c2 = B._fused_sched_stats(cb, cn, cnt, GF, n, t, e)
+    steps, c2, g = B._fused_sched_stats(cb, cn, cnt, GF, n, t, e)
     assert steps == int(plan.f_blk.shape[0])
     assert c2 == int(plan.p2_obi.shape[1])
+    assert g == int(plan.p1_blk.shape[0])
     assert B.fused_plan_steps(cb, cn, cnt, GF, n, t, e) == steps
 
 
@@ -346,9 +377,11 @@ def test_mega_budget_row_ratio():
 # -- memory estimator -----------------------------------------------------
 
 def test_estimator_megafuse_drops_intermediate_bytes():
-    """Fused layers stop materializing the aggregate (and the pre-relu
-    linear out where the relu folds), so their bytes_full must shrink by
-    exactly those tensors; GCN (no match) must be unchanged."""
+    """Fused layers stop materializing every tensor in the match record's
+    ``gone`` tuple; GCN (norm-folded since round 12) now drops its
+    linear + aggregate + second-norm outputs per layer, while the first
+    norm's output stays counted as the proxy for the pre-scaled input
+    the folded path materializes instead."""
     from roc_tpu.memory.estimator import estimate_model
     rows, edges = 4096, 32768
     gin = build_gin([64, 128, 8], 0.5)
@@ -360,8 +393,15 @@ def test_estimator_megafuse_drops_intermediate_bytes():
     assert drop0 == rows * 64 * 4
     assert fused.total_full_bytes() < base.total_full_bytes()
     gcn = build_gcn([64, 128, 8], 0.5)
-    assert estimate_model(gcn, rows, edges, megafuse=True).layers == \
-        estimate_model(gcn, rows, edges).layers
+    gbase = estimate_model(gcn, rows, edges)
+    gfused = estimate_model(gcn, rows, edges, megafuse=True)
+    # GCN layer 0 (hidden, H=128): linear.out + aggregate.out + norm2.out
+    # vanish (final is the relu) = 3 x [rows, 128] fp32
+    gdrop0 = gbase.layers[0].bytes_full - gfused.layers[0].bytes_full
+    assert gdrop0 == 3 * rows * 128 * 4
+    # GCN layer 1 (logits, H=8): final IS norm2, so only linear + agg go
+    gdrop1 = gbase.layers[1].bytes_full - gfused.layers[1].bytes_full
+    assert gdrop1 == 2 * rows * 8 * 4
 
 
 # -- bf16 staging stays flat-only (satellite: decision pinned) ------------

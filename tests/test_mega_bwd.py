@@ -1,0 +1,351 @@
+"""Fused megakernel BACKWARD (round 12): dL/dagg = g @ W^T computed
+inside the Pallas pipeline (ops/pallas/binned.py run_binned_linear_bwd +
+the custom-VJP dispatch in ops/aggregate.py), in interpret mode on CPU.
+
+Bit-equality strategy: the fused backward reassociates fp32 adds
+differently from the two-pass replay, so bitwise parity needs integer
+data whose sums are exact in every intermediate BOTH paths stage:
+
+  * fp32 unit, ``precision="exact"``: staging is fp32 and the 3-way-split
+    dots are exact on small integers, so fused == replay BITWISE.
+  * bf16 unit, ``precision="fast"``: staging rounds to bf16, which is
+    exact only while magnitudes stay <= 256 for odd integers — the tiny
+    construction below keeps every intermediate under that.
+  * ``precision="fast"`` with LARGE integers is deliberately not pinned:
+    the replay stages the (large) ``g @ W^T`` cotangent through bf16
+    while the fused kernel stages (small) ``g`` — the fused path is the
+    more exact one, and they legitimately differ.
+
+Relu tie rule: the fused kernel masks with ``out > 0`` while the
+replay's ``maximum`` VJP emits 0.5*g at EXACT-ZERO pre-activations — a
+measure-zero semantic difference on continuous data, but integer data
+hits exact zeros constantly.  Bitwise relu tests therefore use a
+dominance construction (``_dom_graph``) that guarantees every
+pre-activation is nonzero, and assert that precondition.
+
+On continuous data the exact-precision paths agree to a few
+normalized ULPs (measured <= ~11, pinned <= 32 below; "normalized" =
+abs diff / (eps * row max), the reassociation-error unit).
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu import ops
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gcn, build_sage
+from roc_tpu.ops.pallas import binned as B
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+GF = B.Geometry(sb=256, ch=512, slot=128, rb=256, ch2=512, grt=1 << 14,
+                flat=1)
+GFB = GF._replace(unit=16)
+
+BASE = dict(num_epochs=3, learning_rate=0.01, weight_decay=5e-4,
+            dropout_rate=0.0, eval_every=1000)
+
+_ORIG_BWD_RUN = B._mega_bwd_run
+
+
+def _spy_bwd_run(monkeypatch):
+    """Count real fused-backward launches so replay can't fake a pass."""
+    calls = []
+    monkeypatch.setattr(
+        B, "_mega_bwd_run",
+        lambda *a, **k: (calls.append(1), _ORIG_BWD_RUN(*a, **k))[1])
+    return calls
+
+
+def _dom_graph(n, t, e, h, ho, M, lox, hix, low, hiw, seed):
+    """Integer graph with NO zero pre-activations: ``x[:, 0] == 1`` pins
+    ``agg[:, 0]`` to each row's in-degree (>= 1: dst covers every output
+    row), and ``|w[0, :]| = M > (h-1) * max|x| * max|w|`` makes the first
+    term dominate the dot — ``|pre| >= deg * (M - bound) > 0``."""
+    assert M > (h - 1) * max(abs(lox), hix) * max(abs(low), hiw)
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, t, e).astype(np.int64)
+    dst = np.sort(np.concatenate([np.arange(n, dtype=np.int64),
+                                  rng.integers(0, n, e - n)]))
+    x = rng.integers(lox, hix + 1, (t, h)).astype(np.float32)
+    x[:, 0] = 1.0
+    w = rng.integers(low, hiw + 1, (h, ho)).astype(np.float32)
+    w[0, :] = M * np.where(rng.integers(0, 2, ho) > 0, 1.0, -1.0)
+    return src, dst, x, w
+
+
+def _nonzero_pre(src, dst, n, h, x, w):
+    agg = np.zeros((n, h), np.float32)
+    np.add.at(agg, dst, x[src])
+    return (agg @ w != 0).all()
+
+
+def _grads(src, dst, n, t, x, w, g, geom, precision, act, kill,
+           monkeypatch):
+    """(y, gx, gw, fused launch count) through the layer's custom VJP."""
+    plans = ops.build_binned_plans(src, dst, n, t, geom=geom)
+    if kill:
+        monkeypatch.setenv("ROC_MEGA_BWD", "0")
+        monkeypatch.setattr(B, "_MEGA_BWD_KILL_WARNED", [True])
+    else:
+        monkeypatch.delenv("ROC_MEGA_BWD", raising=False)
+    calls = _spy_bwd_run(monkeypatch)
+    y, vjp = jax.vjp(
+        lambda xx, ww: ops.scatter_gather_linear_binned(
+            xx, ww, plans, True, precision, act),
+        jnp.asarray(x), jnp.asarray(w))
+    gx, gw = vjp(jnp.asarray(g))
+    return np.asarray(y), np.asarray(gx), np.asarray(gw), calls
+
+
+# -- fused backward vs two-pass replay: bitwise lanes ----------------------
+
+@pytest.mark.parametrize("act", ["none", "relu"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_mega_bwd_bitwise_exact_fp32(act, seed, monkeypatch):
+    """fp32 staging unit at ``precision="exact"``: fused backward grads
+    must be BIT-identical to the two-pass replay on integer data, with
+    the in-kernel relu mask active."""
+    n, t, e, h, ho = 96, 128, 800, 64, 32
+    src, dst, x, w = _dom_graph(n, t, e, h, ho, 800, -4, 4, -3, 3, seed)
+    assert _nonzero_pre(src, dst, n, h, x, w)
+    g = np.random.default_rng(seed + 50).integers(-3, 4, (n, ho)) \
+        .astype(np.float32)
+    yf, gxf, gwf, cf = _grads(src, dst, n, t, x, w, g, GF, "exact", act,
+                              False, monkeypatch)
+    assert cf, "fused backward fell back to the two-pass replay"
+    yr, gxr, gwr, cr = _grads(src, dst, n, t, x, w, g, GF, "exact", act,
+                              True, monkeypatch)
+    assert not cr
+    np.testing.assert_array_equal(yf, yr)
+    np.testing.assert_array_equal(gxf, gxr)
+    np.testing.assert_array_equal(gwf, gwr)
+
+
+@pytest.mark.parametrize("act", ["none", "relu"])
+def test_mega_bwd_bitwise_fast_bf16_unit(act, monkeypatch):
+    """bf16 16-row staging unit at ``precision="fast"``: bitwise parity
+    holds while every staged intermediate stays bf16-exact (<= 256), so
+    the construction keeps magnitudes tiny."""
+    n, t, e, h, ho = 96, 128, 700, 8, 8
+    src, dst, x, w = _dom_graph(n, t, e, h, ho, 16, -2, 2, -1, 1, 0)
+    assert _nonzero_pre(src, dst, n, h, x, w)
+    g = np.random.default_rng(60).integers(1, 3, (n, ho)) \
+        .astype(np.float32)
+    yf, gxf, gwf, cf = _grads(src, dst, n, t, x, w, g, GFB, "fast", act,
+                              False, monkeypatch)
+    assert cf
+    yr, gxr, gwr, cr = _grads(src, dst, n, t, x, w, g, GFB, "fast", act,
+                              True, monkeypatch)
+    assert not cr
+    np.testing.assert_array_equal(yf, yr)
+    np.testing.assert_array_equal(gxf, gxr)
+    np.testing.assert_array_equal(gwf, gwr)
+
+
+@pytest.mark.parametrize("act", ["none", "relu"])
+def test_mega_bwd_exact_ulp_bound_continuous(act, monkeypatch):
+    """Continuous data at ``precision="exact"``: the fused backward's add
+    reassociation stays within 32 normalized ULPs of the replay (abs diff
+    over eps * row max; measured <= ~11 at this shape)."""
+    n, t, e, h, ho = 700, 700, 5000, 64, 32
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, t, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    dst[: e // 4] = 7            # hub destination spanning many chunks
+    x = rng.standard_normal((t, h)).astype(np.float32)
+    w = rng.standard_normal((h, ho)).astype(np.float32)
+    g = rng.standard_normal((n, ho)).astype(np.float32)
+    _, gxf, gwf, cf = _grads(src, dst, n, t, x, w, g, GF, "exact", act,
+                             False, monkeypatch)
+    assert cf
+    _, gxr, gwr, cr = _grads(src, dst, n, t, x, w, g, GF, "exact", act,
+                             True, monkeypatch)
+    assert not cr
+    eps = np.finfo(np.float32).eps
+
+    def nulp(a, b):
+        scale = np.maximum(np.abs(b).max(axis=1, keepdims=True), 1e-30)
+        return float((np.abs(a - b) / (eps * scale)).max())
+
+    assert nulp(gxf, gxr) <= 32.0
+    assert nulp(gwf, gwr) <= 32.0
+
+
+# -- kill switch + VMEM gate fallbacks -------------------------------------
+
+def test_mega_bwd_kill_switch_warns_once_and_disables(monkeypatch):
+    monkeypatch.setattr(B, "_MEGA_BWD_KILL_WARNED", [False])
+    monkeypatch.setenv("ROC_MEGA_BWD", "0")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert B.mega_bwd_killed()
+        assert B.mega_bwd_killed()
+    assert sum("ROC_MEGA_BWD" in str(r.message) for r in rec) == 1
+    n, t, e, h, ho = 96, 128, 700, 16, 8
+    rng = np.random.default_rng(17)
+    src = rng.integers(0, t, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    plans = ops.build_binned_plans(src, dst, n, t, geom=GF)
+    g = jnp.ones((n, ho))
+    w = jnp.ones((h, ho))
+    assert B.run_binned_linear_bwd(g, None, w, plans.bwd, True) is None
+    monkeypatch.delenv("ROC_MEGA_BWD")
+    monkeypatch.setattr(B, "_MEGA_BWD_KILL_WARNED", [False])
+    assert not B.mega_bwd_killed()
+    assert B.run_binned_linear_bwd(g, None, w, plans.bwd, True) is not None
+
+
+def test_mega_bwd_vmem_gate_falls_back_to_replay(monkeypatch):
+    """A backward that fails its VMEM gate must replay the two-pass
+    composition — same grads as the kill switch, zero fused launches.
+    The real gate rejects an oversized H_in outright."""
+    assert not B._mega_bwd_vmem_ok(GF, 128, B._pad_to(16384, 128), 3)
+    n, t, e, h, ho = 96, 128, 800, 64, 32
+    src, dst, x, w = _dom_graph(n, t, e, h, ho, 800, -4, 4, -3, 3, 2)
+    g = np.random.default_rng(52).integers(-3, 4, (n, ho)) \
+        .astype(np.float32)
+    monkeypatch.setattr(B, "_mega_bwd_vmem_ok", lambda *a, **k: False)
+    _, gxv, gwv, cv = _grads(src, dst, n, t, x, w, g, GF, "exact", "relu",
+                             False, monkeypatch)
+    assert not cv, "gated backward still launched the fused kernel"
+    monkeypatch.undo()
+    _, gxk, gwk, _ = _grads(src, dst, n, t, x, w, g, GF, "exact", "relu",
+                            True, monkeypatch)
+    np.testing.assert_array_equal(gxv, gxk)
+    np.testing.assert_array_equal(gwv, gwk)
+
+
+# -- VMEM admission + budget pins ------------------------------------------
+
+def test_c2_fp32_admission_pin():
+    """Round-12 acceptance: fp32 staging at C2 > 1 chunks now passes the
+    forward VMEM gate when the schedule has a single bin group (parities
+    collapse to one staging plane); two groups still need both planes and
+    stay rejected, as does H=256 fp32.  The backward gate mirrors it."""
+    GEOM = B.GEOM_FLAT
+    assert B._mega_vmem_ok(GEOM, 128, 128, 3, groups=1)
+    assert not B._mega_vmem_ok(GEOM, 128, 128, 3, groups=2)
+    assert not B._mega_vmem_ok(GEOM, 256, 256, 3, groups=1)
+    assert B._mega_bwd_vmem_ok(GEOM, 128, 128, 3, groups=1)
+    assert B._mega_bwd_vmem_ok(GEOM, 128, 128, 3, groups=1, relu=True)
+    assert not B._mega_bwd_vmem_ok(GEOM, 128, 128, 3, groups=2)
+
+
+def test_mega_bwd_budget_rows_pin():
+    """Acceptance pin: predicted per-layer train-step HBM with the fused
+    backward drops >= 2x vs forward-only fusion at the Reddit shape, and
+    the committed kernel-budget rows carry exactly these numbers (the
+    preflight gate's claim)."""
+    n, h = 32768, 256
+    fwdonly = B.predicted_trainstep_hbm_bytes(n, h, h)
+    megabwd = B.predicted_trainstep_hbm_bytes(n, h, h, mega_bwd=True)
+    assert fwdonly >= 2.0 * megabwd
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "kernel_budgets.json")
+    data = json.load(open(path))
+    r = data["reddit_scaled"]["megakernel_bwd"]
+    assert r["hbm_trainstep_bytes_fwdonly"] == fwdonly
+    assert r["hbm_trainstep_bytes_megabwd"] == megabwd
+    m = data["mega_shard_scaled"]["megakernel_bwd"]
+    for gname in ("flat", "flat_bf16"):
+        row = m[gname]
+        assert row["attaches"]
+        assert row["mega_bwd_steps"] <= 0.85 * row["twopass_bwd_layer_steps"]
+        assert row["vmem_ok_h128"]
+
+
+# -- end-to-end: norm-folded GCN + avg lane + retrace + step cache ---------
+
+def _mega_ds():
+    return datasets.get("mega-shard", seed=1)
+
+
+def _trainstep_ab(build, monkeypatch):
+    """3-epoch A/B at the mega-shard shape, exact aggregation precision:
+    returns {megafuse: (logits, loss)} with the fused backward ACTIVE on
+    the fused leg (launch-count asserted)."""
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    monkeypatch.delenv("ROC_MEGA_BWD", raising=False)
+    ds = _mega_ds()
+    layers = [ds.in_dim, 16, ds.num_classes]
+    out = {}
+    for mf in (False, True):
+        cfg = Config(layers=layers, **BASE, aggregate_backend="binned",
+                     aggregate_precision="exact", megafuse=mf)
+        tr = Trainer(cfg, ds, build(layers, 0.0))
+        calls = _spy_bwd_run(monkeypatch)
+        tr.train(print_fn=lambda *a, **k: None)
+        assert bool(calls) == mf
+        logits = np.asarray(tr._logits_step(tr.params, tr.x, tr.gdata))
+        loss = float(ops.masked_softmax_cross_entropy(
+            jnp.asarray(logits), tr.labels, tr.mask))
+        out[mf] = (logits, loss)
+    return out
+
+
+def test_gcn_norm_folded_trainstep_parity(monkeypatch):
+    """GCN is mega-eligible end to end via norm-folding: 3 training
+    epochs with the fused forward AND backward land within 1e-3 of the
+    unfused leg on logits and loss (acceptance bound; exact precision
+    measures ~1e-6)."""
+    out = _trainstep_ab(build_gcn, monkeypatch)
+    np.testing.assert_allclose(out[True][0], out[False][0], atol=1e-3)
+    assert abs(out[True][1] - out[False][1]) <= 1e-3
+
+
+def test_sage_avg_trainstep_parity(monkeypatch):
+    """The avg lane (SAGE): the fused op runs activation-free, divides by
+    degree and activates outside — same 1e-3 train-step bound."""
+    out = _trainstep_ab(build_sage, monkeypatch)
+    np.testing.assert_allclose(out[True][0], out[False][0], atol=1e-3)
+    assert abs(out[True][1] - out[False][1]) <= 1e-3
+
+
+def test_zero_retraces_with_fused_bwd(monkeypatch):
+    """Steady-state retrace proof with the fused backward active (GCN,
+    norm-folded): fusion direction is trace-time static, so epochs 2..N
+    re-enter the same jitted step."""
+    from roc_tpu.analysis.retrace import RetraceGuard
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    monkeypatch.delenv("ROC_MEGA_BWD", raising=False)
+    ds = _mega_ds()
+    layers = [ds.in_dim, 16, ds.num_classes]
+    cfg = Config(layers=layers, **BASE, aggregate_backend="binned",
+                 megafuse=True)
+    tr = Trainer(cfg, ds, build_gcn(layers, 0.0))
+    calls = _spy_bwd_run(monkeypatch)
+    with RetraceGuard(warmup=1) as g:
+        tr.train(print_fn=lambda *a, **k: None)
+        assert g.counts["train_step"] >= 1
+    assert calls
+
+
+def test_sharded_step_cache_keys_on_mega_bwd(monkeypatch):
+    """ROC_MEGA_BWD rides ShardedGraphData as STATIC metadata: flipping
+    the kill switch changes tree_structure(gd), so the step cache can
+    never serve a program traced with the other backward."""
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    ds = _mega_ds()
+    layers = [ds.in_dim, 8, ds.num_classes]
+
+    def make():
+        return SpmdTrainer(Config(layers=layers, **BASE, num_parts=4,
+                                  halo=True, megafuse=True),
+                           ds, build_gcn(layers, 0.0))
+
+    monkeypatch.delenv("ROC_MEGA_BWD", raising=False)
+    t_on = make()
+    assert t_on.gdata.mega_bwd is True
+    monkeypatch.setenv("ROC_MEGA_BWD", "0")
+    monkeypatch.setattr(B, "_MEGA_BWD_KILL_WARNED", [True])
+    t_off = make()
+    assert t_off.gdata.mega_bwd is False
+    assert jax.tree_util.tree_structure(t_on.gdata) != \
+        jax.tree_util.tree_structure(t_off.gdata)

@@ -34,6 +34,14 @@ steps + the rb-row output sweep the separate linear pass adds), and the
 predicted per-layer HBM traffic at the Reddit shape must drop by at least
 the intermediate's write + read (binned.predicted_layer_hbm_bytes).
 
+Backward rows (round 12): every shape also carries a ``megakernel_bwd``
+entry on the TRANSPOSED edges — the fused backward's grid steps + the
+one remaining dW GEMM sweep vs the VJP replay's full recompute +
+transposed aggregation + three GEMM sweeps, gated at the same 0.85x at
+``mega_shard_scaled``, plus predicted per-layer TRAIN-STEP HBM bytes
+(forward-only vs fwd+bwd fusion) pinned at >= 2x drop at the Reddit
+shape (binned.predicted_trainstep_hbm_bytes).
+
     python tools/check_kernel_budgets.py            # diff, exit 1 on drift
     python tools/check_kernel_budgets.py --update   # regenerate the table
 """
@@ -80,6 +88,12 @@ MEGA_MAX_RATIO = 0.85
 # Hidden width the megakernel HBM pin is evaluated at (binned._MODEL_H).
 MEGA_H = 256
 
+# Min allowed fwdonly/megabwd predicted TRAIN-STEP HBM ratio at the Reddit
+# shape (acceptance: fusing the backward must at least halve the per-layer
+# train-step traffic vs forward-only fusion — the replay's recompute +
+# cotangent round trips dominate; binned.predicted_trainstep_hbm_bytes).
+MEGA_BWD_MIN_DROP = 2.0
+
 
 def _geometries():
     import roc_tpu.ops.pallas.binned as B
@@ -116,6 +130,7 @@ def compute_table():
                 "staging_bytes": int(B.staging_bytes_for(src, dst, geom)),
             }
         entry["megakernel"] = _mega_entry(src, dst, n, e)
+        entry["megakernel_bwd"] = _mega_bwd_entry(src, dst, n, e)
         table[name] = entry
     return table
 
@@ -140,13 +155,56 @@ def _mega_entry(src, dst, n, e):
                "twopass_layer_steps": int(s1 + s2 + lin_steps)}
         r = B._fused_sched_stats(cb, cn, cnt, geom, n, n, e)
         if r is not None:
-            steps, c2 = r
+            steps, c2, g = r
             row.update({
                 "attaches": True,
                 "mega_steps": int(steps),
                 "c2": int(c2),
-                "vmem_ok_h128": bool(B._mega_vmem_ok(geom, 128, 128, c2)),
-                "vmem_ok_h256": bool(B._mega_vmem_ok(geom, 256, 256, c2)),
+                "vmem_ok_h128": bool(B._mega_vmem_ok(geom, 128, 128, c2,
+                                                     groups=g)),
+                "vmem_ok_h256": bool(B._mega_vmem_ok(geom, 256, 256, c2,
+                                                     groups=g)),
+            })
+        out[gname] = row
+    return out
+
+
+def _mega_bwd_entry(src, dst, n, e):
+    """Backward-megakernel row (round 12), computed on the TRANSPOSED
+    edges — the plans.bwd direction the fused backward's grid runs over.
+    ``twopass_bwd_layer_steps`` prices what the VJP replay pays per layer:
+    the forward aggregation again (the recompute), the transposed
+    aggregation, and three rb-row GEMM sweeps (dagg = g@W^T, gw, gx
+    handoff); ``mega_bwd_steps`` is the fused grid plus the single
+    remaining dW GEMM sweep.  The train-step HBM pins use
+    binned.predicted_trainstep_hbm_bytes at H=MEGA_H."""
+    import roc_tpu.ops.pallas.binned as B
+    out = {
+        "hbm_trainstep_bytes_fwdonly":
+            int(B.predicted_trainstep_hbm_bytes(n, MEGA_H, MEGA_H)),
+        "hbm_trainstep_bytes_megabwd":
+            int(B.predicted_trainstep_hbm_bytes(n, MEGA_H, MEGA_H,
+                                                mega_bwd=True)),
+    }
+    for gname, geom in [("flat", B.GEOM_FLAT),
+                        ("flat_bf16", B.GEOM_FLAT_BF16)]:
+        cbf, cnf, cntf = B._cell_stats(src, dst, geom.sb, geom.rb)
+        _, s1f, s2f = B._plan_steps(cbf, cnf, cntf, geom, n, n, e)
+        cb, cn, cnt = B._cell_stats(dst, src, geom.sb, geom.rb)
+        _, s1b, s2b = B._plan_steps(cb, cn, cnt, geom, n, n, e)
+        sweep = -(-n // geom.rb)
+        row = {"attaches": False,
+               "twopass_bwd_layer_steps":
+                   int(s1f + s2f + s1b + s2b + 3 * sweep)}
+        r = B._fused_sched_stats(cb, cn, cnt, geom, n, n, e)
+        if r is not None:
+            steps, c2, g = r
+            row.update({
+                "attaches": True,
+                "mega_bwd_steps": int(steps + sweep),
+                "c2": int(c2),
+                "vmem_ok_h128": bool(B._mega_bwd_vmem_ok(
+                    geom, 128, 128, c2, groups=g, relu=True)),
             })
         out[gname] = row
     return out
@@ -202,11 +260,44 @@ def check_mega_claim(table):
     return problems
 
 
+def check_mega_bwd_claim(table):
+    problems = []
+    m = table["mega_shard_scaled"]["megakernel_bwd"]
+    for gname in ("flat", "flat_bf16"):
+        row = m[gname]
+        if not row["attaches"]:
+            problems.append(f"megakernel backward no longer attaches at "
+                            f"mega_shard_scaled ({gname})")
+            continue
+        steps, layer = row["mega_bwd_steps"], row["twopass_bwd_layer_steps"]
+        if steps > MEGA_MAX_RATIO * layer:
+            problems.append(
+                f"megakernel backward step regression ({gname}): {steps} "
+                f"steps vs two-pass replay {layer} at mega_shard_scaled — "
+                f"ratio {steps / layer:.3f} > {MEGA_MAX_RATIO}")
+        if not row["vmem_ok_h128"]:
+            problems.append(f"megakernel backward VMEM gate rejects "
+                            f"{gname} at H=128 at mega_shard_scaled — "
+                            f"fused backward never runs")
+    # Reddit-shape train-step pin: fwd+bwd fusion must drop predicted
+    # per-layer train-step HBM >= MEGA_BWD_MIN_DROP x vs forward-only.
+    r = table["reddit_scaled"]["megakernel_bwd"]
+    fwdonly = r["hbm_trainstep_bytes_fwdonly"]
+    megabwd = r["hbm_trainstep_bytes_megabwd"]
+    if fwdonly < MEGA_BWD_MIN_DROP * megabwd:
+        problems.append(
+            f"megakernel backward HBM claim: predicted train-step ratio "
+            f"{fwdonly / megabwd:.3f}x < {MEGA_BWD_MIN_DROP}x at "
+            f"reddit_scaled (fwdonly {fwdonly} vs megabwd {megabwd})")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     update = "--update" in argv
     table = compute_table()
-    problems = check_flat_claim(table) + check_mega_claim(table)
+    problems = (check_flat_claim(table) + check_mega_claim(table)
+                + check_mega_bwd_claim(table))
     if update:
         if problems:
             for p in problems:

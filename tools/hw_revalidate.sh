@@ -151,16 +151,29 @@ for flat in 0 1; do
         2097152 $flat 2>&1 | tail -1 | tee -a "$LOG"
 done
 
-note "4c. megakernel A/B at the mega-shard shape (whole-layer fused"
-note "    aggregate->linear vs two-pass, same seed; the -v losses must"
-note "    agree to ~1e-3 and the mega leg skips one [rows, H] HBM round"
-note "    trip per fused layer — record the epoch-time ratio and the"
-note "    kernel_budgets.json mega row's predicted 8-vs-13 layer steps)."
-note "    ROC_BINNED_GEOM pins flat on BOTH legs so the measured delta is"
+note "4c. megakernel FULL TRAIN-STEP A/B at the mega-shard shape: three"
+note "    legs, same seed — (1) two-pass baseline, (2) forward-only fusion"
+note "    (-megafuse with the backward killed via ROC_MEGA_BWD=0), (3)"
+note "    forward+backward fusion (-megafuse, fused VJP).  The -v losses"
+note "    must agree to ~1e-3 across all three; leg 2 vs 1 isolates the"
+note "    forward win, leg 3 vs 2 isolates the backward win (the fused VJP"
+note "    skips the [rows, H] cotangent round trip — kernel_budgets.json"
+note "    megakernel_bwd predicts 10-vs-28 backward layer steps and a"
+note "    >= 2x per-layer train-step HBM drop vs forward-only fusion)."
+note "    Record all three epoch times + the GIN/GCN pair in docs/PERF.md."
+note "    ROC_BINNED_GEOM pins flat on ALL legs so the measured deltas are"
 note "    fusion, not the cost model's geometry pick."
+for leg in "::" "-megafuse:0:" "-megafuse::"; do
+    mf=${leg%%:*}; rest=${leg#*:}; kill=${rest%%:*}
+    ROC_BINNED_GEOM=flat ROC_MEGA_BWD=$kill timeout 900 python -m roc_tpu \
+        -dataset mega-shard -layers 64-128-8 -model gin \
+        -aggr-backend binned -e 10 $mf -v 2>&1 | tail -2 | tee -a "$LOG"
+done
+# norm-folded GCN leg (round 12: GCN is mega-eligible end to end; the
+# fold pre/post-scales by D^-1/2 around the fused kernel)
 for mf in "" "-megafuse"; do
     ROC_BINNED_GEOM=flat timeout 900 python -m roc_tpu \
-        -dataset mega-shard -layers 64-128-8 -model gin \
+        -dataset mega-shard -layers 64-128-8 -model gcn \
         -aggr-backend binned -e 10 $mf -v 2>&1 | tail -2 | tee -a "$LOG"
 done
 fi
