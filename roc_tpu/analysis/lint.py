@@ -48,6 +48,18 @@ preceding line):
     then ``sp.dur_s``): spans land in the exported trace, nest, and are
     disabled in one place.  Only real file paths are checked (inline
     ``lint_source`` fixtures are exempt).
+``unledgered-prediction``
+    A ``predicted_*`` / ``measured_*`` string key in a dict literal, or
+    an ``emit()``/``record_event()`` keyword of that shape, outside
+    ``roc_tpu/obs/`` — the raw-timing rule's sibling for cost models.
+    Predictions flow through the calibration ledger
+    (``obs.get_ledger().predict/measure``) so they content-key-join and
+    show up in `python -m roc_tpu.obs calibration`; an ad-hoc
+    ``predicted_foo`` field never pairs with its measurement and drifts
+    unchecked.  Legacy artifact stampers (bench.py's memory section,
+    the memory plan's ``to_dict``) carry explicit waivers: they
+    serialize already-ledgered values for human-facing JSON, they are
+    not new prediction sites.
 
 A *jitted context* is a function that is (a) decorated with ``jax.jit``
 / ``jax.shard_map`` / ``jax.custom_vjp`` (directly or via ``partial``),
@@ -103,6 +115,10 @@ _REMAT_EXEMPT_SUFFIX = os.path.join("roc_tpu", "memory", "policy.py")
 # (everything else times through `obs.span` so measurements reach the
 # exported trace).
 _RAW_TIMING_EXEMPT_DIR = os.path.join("roc_tpu", "obs") + os.sep
+# Field names that smell like an out-of-ledger prediction/measurement
+# (the unledgered-prediction rule); the ledger itself (roc_tpu/obs/)
+# is exempt — it *is* the sanctioned sink for these.
+_PRED_KEY_RE = re.compile(r"^(predicted|measured)_")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -223,6 +239,7 @@ class _FileLint:
         self._rule_mutable_default()
         self._rule_closure_capture()
         self._rule_remat()
+        self._rule_unledgered_prediction()
         return self.findings
 
     def _rule_jit_scope(self, roots: Set[int]):
@@ -407,6 +424,35 @@ class _FileLint:
                            f"planner's budget accounting; route remat "
                            f"through roc_tpu/memory (-mem-plan) or waive "
                            f"with a rationale")
+
+    def _rule_unledgered_prediction(self):
+        """predicted_*/measured_* fields minted outside the ledger."""
+        if _RAW_TIMING_EXEMPT_DIR in self.path.replace("/", os.sep):
+            return  # roc_tpu/obs/ is the ledger — the sanctioned sink
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            _PRED_KEY_RE.match(k.value):
+                        self._flag(
+                            k, "unledgered-prediction",
+                            f"dict key {k.value!r} mints a prediction/"
+                            f"measurement outside the calibration ledger; "
+                            f"route it through obs.get_ledger()."
+                            f"predict/measure so it content-key-joins, or "
+                            f"waive with a rationale")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("emit", "record_event"):
+                for kw in node.keywords:
+                    if kw.arg and _PRED_KEY_RE.match(kw.arg):
+                        self._flag(
+                            node, "unledgered-prediction",
+                            f"{node.func.attr}(..., {kw.arg}=...) emits a "
+                            f"prediction/measurement field outside the "
+                            f"calibration ledger; use obs.get_ledger()."
+                            f"predict/measure so it content-key-joins")
 
     def _rule_closure_capture(self):
         for loop in ast.walk(self.tree):

@@ -12,7 +12,12 @@ the same with a weighted ridge least-squares over the telemetry ring buffer
     rate of the chunked aggregation — plus an ICI-bandwidth term for halo
     rows.  ``fit`` mixes these as low-weight pseudo-samples, so early fits
     interpolate between the prior and the first real probes instead of
-    extrapolating from 4 points in a 5-dim space.
+    extrapolating from 4 points in a 5-dim space.  When a DEVICE-measured
+    per-kernel table is committed (tools/kernel_bench.py ->
+    binned.measured_calibration), the prior's rates come from it and the
+    pseudo-samples ride at MEASURED_PRIOR_WEIGHT instead of PRIOR_WEIGHT
+    — a measured prior is trusted harder, cutting the probes needed to
+    reach a usable fit.
 
   * **Column scaling.**  edges ~ 1e4..1e8 while the constant column is 1;
     unscaled normal equations lose the small coefficients.  We solve in
@@ -38,6 +43,12 @@ _PRIOR_HALO_WIDTH = 32
 _PRIOR_HALO_ITEMSIZE = 4
 # Relative weight of a synthesized prior sample vs a measured probe.
 PRIOR_WEIGHT = 0.1
+# Prior weight when the per-chunk rates behind it are DEVICE-MEASURED
+# (tools/kernel_bench.py's table, binned.measured_calibration) rather
+# than hand-fit constants: a measured prior has earned more pull, so
+# early rounds lean on it harder and reach a trustworthy fit in fewer
+# probes (tests/test_balance.py pins the probes-to-R^2 win).
+MEASURED_PRIOR_WEIGHT = 0.5
 
 
 def prior_times(X: np.ndarray, halo_width: int = _PRIOR_HALO_WIDTH,
@@ -57,15 +68,27 @@ class OnlineCostModel:
 
     def __init__(self, ridge: float = 1e-8,
                  halo_width: int = _PRIOR_HALO_WIDTH,
-                 halo_itemsize: int = _PRIOR_HALO_ITEMSIZE):
+                 halo_itemsize: int = _PRIOR_HALO_ITEMSIZE,
+                 measured: Optional[bool] = None):
         self.ridge = float(ridge)
         # The run's actual exchanged-feature width and wire itemsize (bf16
         # storage halves the latter); only the warm-start prior uses them.
         self.halo_width = int(halo_width)
         self.halo_itemsize = int(halo_itemsize)
+        # None = autodetect: the prior rides at MEASURED_PRIOR_WEIGHT when
+        # a device kernel_bench table backs its rates, PRIOR_WEIGHT when
+        # they are the hand-fit constants.
+        self.measured = measured
         self.w: Optional[np.ndarray] = None  # [5], unscaled feature space
         self.r2: Optional[float] = None      # of the last fit's probe rows
         self.num_fits = 0
+
+    def prior_weight(self) -> float:
+        if self.measured is None:
+            from roc_tpu.ops.pallas.binned import measured_calibration
+            return (MEASURED_PRIOR_WEIGHT if measured_calibration()
+                    else PRIOR_WEIGHT)
+        return MEASURED_PRIOR_WEIGHT if self.measured else PRIOR_WEIGHT
 
     def fit(self, X: np.ndarray, t: np.ndarray,
             weights: Optional[np.ndarray] = None,
@@ -86,7 +109,7 @@ class OnlineCostModel:
             Xf = np.concatenate([X, X], axis=0)
             tf = np.concatenate([t, prior_times(X, self.halo_width,
                                                 self.halo_itemsize)])
-            wf = np.concatenate([w, np.full(n, PRIOR_WEIGHT)])
+            wf = np.concatenate([w, np.full(n, self.prior_weight())])
         self.w = _weighted_ridge(Xf, tf, wf, self.ridge)
         self.num_fits += 1
         pred = X @ self.w
@@ -108,13 +131,18 @@ class OnlineCostModel:
         nondecreasing in the vertex range — the property the parametric
         binary search and the DP both need."""
         if self.w is None:
-            # Prior in weight form: per-edge + per-row chunk rate, halo bytes.
-            from roc_tpu.ops.pallas.binned import _MM_CHUNK_S
+            # Prior in weight form: per-edge + per-row chunk rate, halo
+            # bytes.  Same measured-rate substitution as prior_times (via
+            # _matmul_cost): a committed device kernel_bench table
+            # recalibrates this rate too.
+            from roc_tpu.ops.pallas.binned import (_MM_CHUNK_S,
+                                                   measured_calibration)
             from roc_tpu.ops.pallas.segment_sum import EB, VB
+            rate = ((measured_calibration() or {}).get("mm_chunk_s")
+                    or _MM_CHUNK_S)
             halo = (self.halo_width * float(self.halo_itemsize)
                     / _PRIOR_ICI_BYTES_PER_S)
-            return np.array([_MM_CHUNK_S / VB, _MM_CHUNK_S / EB,
-                             halo, halo, 0.0])
+            return np.array([rate / VB, rate / EB, halo, halo, 0.0])
         w = self.w.copy()
         w[:4] = np.maximum(w[:4], 0.0)
         return w

@@ -169,7 +169,26 @@ class BalanceManager:
             return None
         graph = trainer.dataset.graph
         self.rounds += 1
+        # Calibration pair for the fitted cost model: predict the slowest
+        # shard's probe time BEFORE probing (only once a measured fit
+        # exists — round 1 would test the warm-start prior, not the fit),
+        # measure it right after.  One pair per balance round.
+        led = obs.get_ledger()
+        pred_key = None
+        if led.attached and self.rounds > 1:
+            from roc_tpu.obs.ledger import content_key
+            feats = search.part_features(graph.row_ptr, graph.col_idx,
+                                         part.bounds)
+            pred_key = content_key(round=self.rounds,
+                                   parts=part.num_parts)
+            led.predict("shard_cost", pred_key,
+                        float(np.max(self.model.predict(feats))), "s",
+                        epoch=int(epoch))
         samples = self.collect(part, graph, epoch)
+        if pred_key is not None:
+            led.measure("shard_cost", pred_key,
+                        max(s.time_s for s in samples), "s",
+                        epoch=int(epoch))
         if self.watchdog is not None:
             # same probe times the cost model fits; a straggler alert
             # lands in the JSONL next to the round that should fix it

@@ -32,11 +32,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Tuple
 
-# TPU peaks matching bench.py's roofline constants (v5e bf16 / HBM); used
-# only to PRICE recompute relative to step time, never as a claim about
-# achieved throughput.
-PEAK_FLOPS = 197e12
-PEAK_BW = 819e9
+# TPU peaks from the single-source roofline module (obs/roofline.py, the
+# same constants bench.py reports against); used here only to PRICE
+# recompute relative to step time, never as a claim about achieved
+# throughput.
+from roc_tpu.obs.roofline import PEAK_BW, PEAK_FLOPS
 # Feature width _MM_CHUNK_S (the aggregation chunk prior) was measured at
 # (the reddit bench's in_dim); aggregation recompute scales linearly in
 # width from there.

@@ -6,6 +6,10 @@
                (zero host syncs / collectives / retraces)
   metrics.py   registry + exporters over the balance-telemetry JSONL schema
   watchdog.py  EWMA slow-epoch + shard-straggler detector, budget-seeded
+  roofline.py  THE peak-FLOPs/BW constants + op-IR FLOPs/bytes accounting
+               (stdlib-only, like the tracer — kernel modules import it)
+  ledger.py    calibration ledger: content-keyed prediction/measurement
+               records, joined by `python -m roc_tpu.obs calibration`
   report.py    `python -m roc_tpu.obs report` + the preflight selftest
 
 Entry points: `with obs.span("phase"): ...` anywhere on the host;
@@ -21,7 +25,8 @@ from roc_tpu.obs.tracer import (SpanTracer, enable, enabled, get_tracer,
 
 __all__ = ["SpanTracer", "enable", "enabled", "get_tracer", "span",
            "validate_chrome_trace", "MetricsRegistry", "PerfWatchdog",
-           "channel", "load_jsonl", "seed_for_graph"]
+           "channel", "load_jsonl", "seed_for_graph", "roofline", "ledger",
+           "get_ledger"]
 
 
 # import_module (not `from ... import`): a from-import of a submodule not
@@ -30,7 +35,10 @@ _LAZY = {"MetricsRegistry": ("roc_tpu.obs.metrics", "MetricsRegistry"),
          "load_jsonl": ("roc_tpu.obs.metrics", "load_jsonl"),
          "PerfWatchdog": ("roc_tpu.obs.watchdog", "PerfWatchdog"),
          "seed_for_graph": ("roc_tpu.obs.watchdog", "seed_for_graph"),
-         "channel": ("roc_tpu.obs.channel", None)}
+         "channel": ("roc_tpu.obs.channel", None),
+         "roofline": ("roc_tpu.obs.roofline", None),
+         "ledger": ("roc_tpu.obs.ledger", None),
+         "get_ledger": ("roc_tpu.obs.ledger", "get_ledger")}
 
 
 def __getattr__(name):

@@ -1,8 +1,12 @@
-"""CLI: `python -m roc_tpu.obs report|selftest`.
+"""CLI: `python -m roc_tpu.obs report|calibration|selftest`.
 
-report   — text summary of a -obs run's trace.json + metrics.jsonl
-selftest — the preflight obs gate (tracer schema, watchdog fire/quiet,
-           span overhead bound); exit 0 green, 1 red
+report      — text summary of a -obs run's trace.json + metrics.jsonl
+calibration — join a run's prediction/measurement ledger records and
+              report per-cost-model calibration error; --selftest runs
+              the preflight gate (tiny CPU runs must pair >= 5 models
+              inside their sanity bands)
+selftest    — the preflight obs gate (tracer schema, watchdog
+              fire/quiet, span overhead bound); exit 0 green, 1 red
 """
 
 from __future__ import annotations
@@ -21,12 +25,27 @@ def main(argv=None) -> int:
                     help="obs output dir (default: roc_obs)")
     rp.add_argument("-trace", default="", help="trace.json path override")
     rp.add_argument("-metrics", default="", help="metrics.jsonl override")
+    cp = sub.add_parser("calibration",
+                        help="per-cost-model predicted-vs-measured report")
+    cp.add_argument("-dir", dest="obs_dir", default="roc_obs",
+                    help="obs output dir (default: roc_obs)")
+    cp.add_argument("-metrics", default="", help="metrics.jsonl override")
+    cp.add_argument("--selftest", action="store_true",
+                    help="preflight gate: tiny CPU runs must pair >= 5 "
+                         "cost models inside their sanity bands")
     sub.add_parser("selftest", help="obs gate: schema + watchdog + overhead")
     ns = p.parse_args(argv)
 
     if ns.cmd == "selftest":
         from roc_tpu.obs.report import selftest
         return selftest()
+
+    if ns.cmd == "calibration":
+        from roc_tpu.obs.report import calibration, calibration_selftest
+        if ns.selftest:
+            return calibration_selftest()
+        return calibration(ns.metrics
+                           or os.path.join(ns.obs_dir, "metrics.jsonl"))
 
     from roc_tpu.obs.report import report
     trace = ns.trace or os.path.join(ns.obs_dir, "trace.json")

@@ -34,6 +34,10 @@ class MetricsRegistry:
         # (kind, fields) tail + latest scalar per "<kind>_<field>" series
         self.records: deque = deque(maxlen=_RECORD_TAIL)
         self.latest: dict = {}
+        # labeled gauges: (name, ((label, value), ...)) -> float.
+        # Exporter-only state (the JSONL already carries the records they
+        # are derived from).
+        self.gauges: dict = {}
 
     def emit(self, kind: str, /, **fields):
         """One record: JSONL line (shared schema) + in-memory tail.
@@ -45,6 +49,19 @@ class MetricsRegistry:
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
             self.latest[f"{kind}_{k}"] = float(v)
+        # Calibration-ledger measurements additionally export as a
+        # per-model labeled gauge: roc_calibration_ratio{model="..."}.
+        if kind == "measurement" and "ratio" in fields and "model" in fields:
+            self.set_gauge("calibration_ratio", fields["ratio"],
+                           model=str(fields["model"]))
+
+    def set_gauge(self, name: str, value, **labels) -> None:
+        """Latest value of a labeled Prometheus gauge (write_prometheus
+        renders it; non-numeric values are dropped, like ``latest``)."""
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        self.gauges[(str(name), tuple(sorted(
+            (str(k), str(v)) for k, v in labels.items())))] = float(value)
 
     def of_kind(self, kind: str) -> List[dict]:
         return [f for k, f in self.records if k == kind]
@@ -56,20 +73,50 @@ class MetricsRegistry:
                 if k == kind and field in f]
 
     def write_prometheus(self, path: str) -> bool:
-        """Latest scalar per series as a Prometheus textfile (best-effort,
-        like every exporter here: observability must never kill a run)."""
+        """Latest scalar per series (plus labeled gauges) as a Prometheus
+        textfile (best-effort, like every exporter here: observability
+        must never kill a run).  Non-finite values are skipped — a NaN
+        gauge poisons rate()/avg() queries downstream and carries no
+        information a missing series doesn't."""
+        import math
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             lines = []
             for name in sorted(self.latest):
-                metric = "roc_" + "".join(
-                    c if c.isalnum() or c == "_" else "_" for c in name)
-                lines.append(f"{metric} {self.latest[name]:.10g}")
+                v = self.latest[name]
+                if not math.isfinite(v):
+                    continue
+                lines.append(f"{_metric_name(name)} {v:.10g}")
+            for (name, labels) in sorted(self.gauges):
+                v = self.gauges[(name, labels)]
+                if not math.isfinite(v):
+                    continue
+                lab = ",".join(f'{_metric_name(k, prefix="")}='
+                               f'"{_escape_label_value(val)}"'
+                               for k, val in labels)
+                lines.append(f"{_metric_name(name)}"
+                             f"{{{lab}}} {v:.10g}" if lab
+                             else f"{_metric_name(name)} {v:.10g}")
             with open(path, "w", encoding="utf-8") as f:
                 f.write("\n".join(lines) + "\n")
             return True
         except OSError:
             return False
+
+
+def _metric_name(name: str, prefix: str = "roc_") -> str:
+    """Sanitize to the Prometheus metric/label-name charset
+    [a-zA-Z_][a-zA-Z0-9_]*."""
+    out = prefix + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline (exposition format spec)."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+             .replace("\n", "\\n"))
 
 
 def load_jsonl(path: str) -> List[dict]:

@@ -1,0 +1,131 @@
+"""The ONE roofline: peak constants + epoch FLOPs/bytes walked from the
+op IR, shared by bench.py, the memory estimator, and the binned kernels.
+
+Before this module, the peak-FLOPs/bandwidth constants and the
+model-FLOPs formula lived twice (bench.py and memory/estimator.py) and
+the HBM-bandwidth figure a third time (ops/pallas/binned.py) — exactly
+the measurement-methodology drift that corrupts cross-run comparisons.
+Every mfu / roofline_frac / recompute-price figure in the tree now flows
+through here, so a constant re-fit (hw_revalidate) lands everywhere at
+once.
+
+Stdlib-only on purpose: kernel modules (ops/pallas) import the constants
+at module load, before jax/numpy are welcome.
+
+Accounting convention (standard MFU): count matmul/aggregation terms
+only — norms, activations, dropout, and the optimizer are O(N*F) noise
+against the N*F*F' and E*F terms.  Per op, for one training epoch
+(fwd + bwd + opt):
+
+  linear Fin->Fout:  6*N*Fin*Fout FLOPs (fwd + dX + dW),
+                     3*(N*Fin + N*Fout)*b bytes (3 passes/epoch)
+  aggregate at F:    4*E*F FLOPs (fwd + transposed bwd),
+                     2*(E*F*b + N*F*b + E*4) bytes — every edge reads its
+                     source row once per pass (gathers don't cache across
+                     destinations in the worst case) + result writes +
+                     index bytes  [scattergather_kernel.cu:20-76 is the
+                     reference's corresponding hot kernel]
+  gat (K heads, head_dim D): the projection matmul folded into the op
+                     (Fin -> K*D) plus the aggregation sweep at K*D; the
+                     per-edge score/softmax terms are O(E*K) and dropped.
+
+b = 2 (bf16 fast path) or 4 (fp32 exact).  Walking the IR (instead of
+re-deriving widths from a layer spec) makes residual projections, GAT
+head folding, and SAGE concat widths come out right by construction.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["PEAK_FLOPS", "PEAK_BW", "TPU_BACKENDS", "itemsize_for",
+           "model_flops_bytes", "roofline_time", "mfu", "roofline_frac"]
+
+
+def _env_float(name: str, default: float) -> float:
+    """Env-overridable constant with a safe fallback — a malformed value
+    must not break import (bench.py's one-JSON-line contract)."""
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
+# Per-chip peaks; v5e: 197 TFLOP/s bf16 MXU, 819 GB/s HBM (public spec
+# sheet).  Overridable for new hardware — this is the single definition
+# site (`grep -rn PEAK_FLOPS` acceptance gate).
+PEAK_FLOPS = _env_float("ROC_BENCH_PEAK_FLOPS", 197e12)
+PEAK_BW = _env_float("ROC_BENCH_PEAK_BW_BYTES", 819e9)
+
+# Backends the PEAK_* figures describe ("axon" is this container's tunnel
+# name for the real v5e chip).  mfu / roofline_frac are only *claimed*
+# against these — on any other backend the number would be plausible but
+# meaningless.
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def itemsize_for(precision: str = "fast") -> int:
+    """Feature-stream element width under the aggregation precision."""
+    return 2 if precision == "fast" else 4
+
+
+def model_flops_bytes(model, num_nodes: int, num_edges: int,
+                      precision: str = "fast"):
+    """(FLOPs, min HBM bytes) for ONE training epoch of ``model`` on a
+    graph of ``num_nodes`` rows / ``num_edges`` in-edges, walked from the
+    op IR (models/model.py) under the convention in the module docstring.
+
+    The bytes figure is the standard SpMM roofline lower bound;
+    roofline_frac = that bound over the measured time, 1.0 = at the
+    roofline.
+    """
+    N, E = float(num_nodes), float(num_edges)
+    b = itemsize_for(precision)
+    dims = {model.input.id: model.input.dim}
+    flops = nbytes = 0.0
+    for op in model.ops:
+        a = dims[op.inputs[0]]
+        if op.kind == "linear":
+            out = int(op.attrs["out_dim"])
+            flops += 6.0 * N * a * out
+            nbytes += 3.0 * (N * a * b + N * out * b)
+        elif op.kind == "gat":
+            out = int(op.attrs["heads"]) * int(op.attrs["head_dim"])
+            flops += 6.0 * N * a * out + 4.0 * E * out
+            nbytes += 3.0 * (N * a * b + N * out * b)
+            nbytes += 2.0 * (E * out * b + N * out * b + E * 4)
+        elif op.kind == "aggregate":
+            out = a
+            flops += 4.0 * E * out
+            nbytes += 2.0 * (E * out * b + N * out * b + E * 4)
+        else:
+            out = a          # elementwise: O(N*F) noise, not counted
+        dims[op.out] = out
+    return flops, nbytes
+
+
+def roofline_time(flops: float, nbytes: float, n_dev: int = 1,
+                  peak_flops: float = None, peak_bw: float = None) -> float:
+    """Best-possible epoch seconds: max of the compute- and memory-bound
+    lower bounds across ``n_dev`` chips."""
+    pf = PEAK_FLOPS if peak_flops is None else peak_flops
+    pb = PEAK_BW if peak_bw is None else peak_bw
+    return max(flops / (n_dev * pf), nbytes / (n_dev * pb))
+
+
+def mfu(flops: float, seconds: float, n_dev: int = 1,
+        peak_flops: float = None):
+    """Achieved model-FLOPs/s over the chips' peak; None if unmeasurable."""
+    pf = PEAK_FLOPS if peak_flops is None else peak_flops
+    if seconds <= 0.0 or pf <= 0.0:
+        return None
+    return flops / seconds / (n_dev * pf)
+
+
+def roofline_frac(flops: float, nbytes: float, seconds: float,
+                  n_dev: int = 1, peak_flops: float = None,
+                  peak_bw: float = None):
+    """roofline_time over the measured seconds; 1.0 = at the roofline."""
+    if seconds <= 0.0:
+        return None
+    return roofline_time(flops, nbytes, n_dev, peak_flops, peak_bw) / seconds

@@ -32,6 +32,12 @@ DEFAULT_ALPHA = 0.25       # EWMA smoothing (higher = adapts faster)
 DEFAULT_WARMUP = 2         # unseeded: observe this many epochs first
                            # (epoch 0 carries compile time; never judge it)
 STRAGGLER_RATIO = 2.0      # shard alert when t > ratio x median shard time
+# Calibration drift: alert when a cost model's measured/predicted ratio
+# EWMA leaves this band.  Wide on purpose — the analytic models are
+# order-of-magnitude instruments (the step-count models sit at exactly
+# 1.0; the time models carry TPU-fit constants) and the alert exists for
+# "the model stopped describing reality", not for 20% noise.
+CALIBRATION_BAND = (0.5, 2.0)
 
 
 class PerfWatchdog:
@@ -41,7 +47,8 @@ class PerfWatchdog:
                  alpha: float = DEFAULT_ALPHA,
                  warmup: int = DEFAULT_WARMUP,
                  seed_s: Optional[float] = None,
-                 straggler_ratio: float = STRAGGLER_RATIO):
+                 straggler_ratio: float = STRAGGLER_RATIO,
+                 calibration_band=CALIBRATION_BAND):
         self.ratio = float(ratio)
         self.alpha = float(alpha)
         self.warmup = int(warmup)
@@ -54,6 +61,11 @@ class PerfWatchdog:
         # host->device prefetch; stream executor runs only)
         self.stall_ewma: Optional[float] = None
         self.stall_observed = 0
+        # per-cost-model measured/predicted ratio EWMAs (ledger feed)
+        self.calibration_band = (float(calibration_band[0]),
+                                 float(calibration_band[1]))
+        self.calib_ewma: dict = {}
+        self.calib_observed: dict = {}
 
     def observe_epoch(self, epoch: int, wall_s: float) -> Optional[dict]:
         """Feed one epoch's wall time; returns an alert dict or None."""
@@ -123,9 +135,37 @@ class PerfWatchdog:
         self.alerts.extend(alerts)
         return alerts
 
+    def observe_calibration(self, model: str, ratio: float,
+                            epoch: int = -1) -> Optional[dict]:
+        """Feed one joined (cost model, measured/predicted ratio) pair
+        from the calibration ledger; returns a drift alert when the
+        model's ratio EWMA leaves ``calibration_band``.  Per-model warmup
+        mirrors observe_epoch: the first ``warmup`` pairs only build the
+        EWMA (a model's very first joins may carry compile-epoch noise),
+        later pairs are judged."""
+        r = float(ratio)
+        if r <= 0:
+            return None    # a non-positive ratio is a broken pair, not drift
+        model = str(model)
+        ew = self.calib_ewma.get(model)
+        self.calib_ewma[model] = r if ew is None else \
+            self.alpha * r + (1.0 - self.alpha) * ew
+        seen = self.calib_observed.get(model, 0) + 1
+        self.calib_observed[model] = seen
+        lo, hi = self.calibration_band
+        cur = self.calib_ewma[model]
+        if seen <= self.warmup or lo <= cur <= hi:
+            return None
+        alert = {"kind": "calibration-drift", "epoch": int(epoch),
+                 "model": model, "ewma_ratio": float(cur),
+                 "band_lo": lo, "band_hi": hi}
+        self.alerts.append(alert)
+        return alert
+
     def verdict(self) -> str:
         """"regressed" if any slow-epoch fired, then "straggler", then
-        "stream-stall", "ok" otherwise — stamped into bench artifacts."""
+        "stream-stall", then "calibration-drift", "ok" otherwise —
+        stamped into bench artifacts."""
         kinds = {a["kind"] for a in self.alerts}
         if "slow-epoch" in kinds:
             return "regressed"
@@ -133,6 +173,8 @@ class PerfWatchdog:
             return "straggler"
         if "stream-stall" in kinds:
             return "stream-stall"
+        if "calibration-drift" in kinds:
+            return "calibration-drift"
         return "ok"
 
 

@@ -74,6 +74,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 # stdlib-only tracer entry point (no obs package body is pulled in here)
 from roc_tpu.obs.tracer import span as _obs_span
+# Calibration ledger (stdlib-only, like the tracer): choose_geometry
+# PREDICTS the winning schedule's step/staging-row counts, the plan
+# builder MEASURES what it actually built, and the obs stream records
+# both — `python -m roc_tpu.obs calibration` reads the ratio.
+from roc_tpu.obs.ledger import content_key as _content_key
+from roc_tpu.obs.ledger import get_ledger as _get_ledger
 
 SB = 512      # source rows per x block (phase-1 streaming unit)
 CH = 2048     # edge slots per phase-1 chunk
@@ -469,15 +475,65 @@ _MM_CHUNK_S = 2.9e-6
 _MODEL_H = 256                # nominal width: plans are H-independent
 # HBM bandwidth for the fuse_linear round-trip credit (choose_geometry):
 # one [rows, H] fp32 intermediate written by the aggregate and read back
-# by the linear is what the megakernel eliminates.  Matches
-# roc_tpu/memory/estimator.PEAK_BW (v5e ~819 GB/s).
-_HBM_BW = 819e9
+# by the linear is what the megakernel eliminates.  The single-source
+# roofline constant (obs/roofline.py, stdlib-only): one re-fit lands in
+# bench.py, the memory estimator, and this credit at once.
+from roc_tpu.obs.roofline import PEAK_BW as _HBM_BW  # noqa: E402
 # VMEM feasibility for choose_geometry's candidates, at the nominal model
 # width and bf16 staging (the "fast" precision the hardware path runs):
 # phase 1 holds the ch x sb one-hot, double gbuf, and an sb x H x block;
 # phase 2 the ch2 x rb one-hot, a ch2 x H staging chunk, and the fp32
 # rb x H resident window.  ~16 MB/core on v5e; leave headroom.
 _VMEM_BUDGET = 14 * (1 << 20)
+
+
+_MEASURED_CAL: dict = {}   # path -> parsed rates (None = no device table)
+
+
+def measured_calibration(path: str = ""):
+    """Device-measured kernel rates from the ``measured`` table
+    tools/kernel_bench.py persists into tools/kernel_budgets.json:
+    ``{"chunk_s": <binned per-grid-step s>, "mm_chunk_s": <matmul
+    per-chunk s or None>}`` (medians over the benched shapes/variants).
+
+    Returns None — analytic constants stay in charge — when no table
+    exists, the table was recorded in interpret mode (CPU harness
+    timings, not rates), or ROC_NO_MEASURED_CAL=1 kills it.  The cost
+    model (_binned_cost_model / _matmul_cost) and the balance prior
+    (balance/cost_model.py) warm-start from these in place of the
+    hand-fit _CHUNK_OVERHEAD_S / _MM_CHUNK_S.  Cached per path;
+    ROC_MEASURED_CAL_PATH overrides the default table location."""
+    if os.environ.get("ROC_NO_MEASURED_CAL"):
+        return None
+    if not path:
+        path = os.environ.get("ROC_MEASURED_CAL_PATH") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "..", "..", "..", "tools", "kernel_budgets.json")
+    path = os.path.abspath(path)
+    if path in _MEASURED_CAL:
+        return _MEASURED_CAL[path]
+    import json
+    cal = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            m = json.load(f).get("measured") or {}
+        if not m.get("interpret", True):
+            steps, mm = [], []
+            for shp in m.get("shapes", {}).values():
+                for row in shp.get("kernels", {}).values():
+                    if row.get("variant") == "matmul":
+                        mm.append(float(row["per_chunk_s"]))
+                    elif "per_step_s" in row:
+                        steps.append(float(row["per_step_s"]))
+            if steps:
+                steps.sort()
+                mm.sort()
+                cal = {"chunk_s": steps[len(steps) // 2],
+                       "mm_chunk_s": mm[len(mm) // 2] if mm else None}
+    except (OSError, ValueError, KeyError, TypeError):
+        cal = None
+    _MEASURED_CAL[path] = cal
+    return cal
 
 
 def _matmul_chunks(num_edges: int, num_rows: int) -> int:
@@ -489,7 +545,9 @@ def _matmul_chunks(num_edges: int, num_rows: int) -> int:
 
 
 def _matmul_cost(num_edges: int, num_rows: int) -> float:
-    return _matmul_chunks(num_edges, num_rows) * _MM_CHUNK_S
+    cal = measured_calibration()
+    rate = (cal or {}).get("mm_chunk_s") or _MM_CHUNK_S
+    return _matmul_chunks(num_edges, num_rows) * rate
 
 
 def _vmem_bytes(geom: Geometry, H: int = _MODEL_H,
@@ -527,10 +585,15 @@ def _binned_cost_model(padded_rows: int, geom: Geometry,
     rows2 = steps2 * geom.ch2 if steps2 is not None else padded_rows
     mac1 = rows1 * geom.sb * H * 2 / _MXU_EFF_FLOPS
     mac2 = rows2 * geom.rb * H * 2 / _MXU_EFF_FLOPS
+    # Per-grid-step overhead: the measured rate from the last hardware
+    # kernel_bench run when one is committed, the hand-fit constant
+    # otherwise (measured_calibration — interpret tables never apply).
+    cal = measured_calibration()
+    step_s = (cal or {}).get("chunk_s") or _CHUNK_OVERHEAD_S
     ov1 = (steps1 if steps1 is not None
-           else padded_rows / geom.ch) * _CHUNK_OVERHEAD_S
+           else padded_rows / geom.ch) * step_s
     ov2 = (steps2 if steps2 is not None
-           else padded_rows / geom.ch2) * _CHUNK_OVERHEAD_S
+           else padded_rows / geom.ch2) * step_s
     if geom.flat:
         # Flat staging writes are per-run size-classed DMAs, not per-slot:
         # a typical cell (~1 run) moves in a few descriptors.  Modeled at
@@ -816,6 +879,34 @@ def staging_bytes_for(edge_src: np.ndarray, edge_dst: np.ndarray,
             * staging_itemsize(geom, exact))
 
 
+def _plan_key(num_rows: int, table_rows: int, num_edges: int,
+              geom: Geometry) -> str:
+    """Content key joining choose_geometry's schedule predictions to the
+    built plan's measurements: the full schedule-shaping input (shape +
+    geometry tuple), so a prediction only ever pairs with the plan it was
+    made for."""
+    return _content_key(rows=int(num_rows), table_rows=int(table_rows),
+                        edges=int(num_edges),
+                        geom="/".join(str(v) for v in tuple(geom)))
+
+
+def _ledger_note_plan(plan: "BinnedPlan", num_edges: int) -> None:
+    """Measurement half of the plan_steps/staging_rows pairs: the BUILT
+    plan's actual grid-step and staging-row counts, read off the plan
+    arrays' shapes (O(1), host-side).  _plan_steps is exact by
+    construction (test_plan_steps_match_built_plans), so a ratio off 1.0
+    here means the predictor and builder have drifted apart."""
+    led = _get_ledger()
+    if not led.attached:
+        return
+    g = plan.geom
+    G, C1 = plan.p1_blk.shape
+    C2 = plan.p2_obi.shape[1]
+    key = _plan_key(plan.num_rows, plan.table_rows, num_edges, g)
+    led.measure("plan_steps", key, G * (C1 + C2), "steps")
+    led.measure("staging_rows", key, G * C2 * g.ch2, "rows")
+
+
 def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
                     num_rows: int, table_rows: int,
                     candidates=None, force: bool = False,
@@ -884,6 +975,7 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
         rt = (2 * num_rows * _MODEL_H * 4 / _HBM_BW
               + -(-num_rows // 512) * _CHUNK_OVERHEAD_S)
     best, best_t = None, float("inf")
+    best_steps = None   # winner's (s1, s2) for the calibration ledger
     stats_cache = {}
     for g in cands:
         g = g.check()
@@ -908,7 +1000,7 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
                 # scale the two-pass aggregation model by the step ratio
                 t *= fs[0] / max(s1 + s2, 1)
         if t < best_t:
-            best, best_t = g, t
+            best, best_t, best_steps = g, t, (s1, s2)
         # Hybrid variant: the sub-half-full cells' edges go to the matmul
         # side (they pay its per-chunk rate but no slot padding); the
         # matmul window floor is a fixed cost of having a matmul side at
@@ -928,9 +1020,22 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
                    + _matmul_cost(E_thin, num_rows)
                    + rt)    # hybrid plans carry a matmul side: never mega
             if t_h < best_t:
-                best, best_t = g._replace(hub_minc=minc), t_h
+                best = g._replace(hub_minc=minc)
+                best_t, best_steps = t_h, (s1_d, s2_d)
     t_matmul = _matmul_cost(E, num_rows) + rt
     if force or (best is not None and best_t < t_matmul):
+        if best is not None and best_steps is not None:
+            # Prediction half of the plan_steps/staging_rows calibration
+            # pairs: the built plan's counts (build_binned_plan) join by
+            # content key.  geom_time stays unpaired off-device — only a
+            # hardware run (tools/kernel_bench.py) measures it.
+            led = _get_ledger()
+            if led.attached:
+                key = _plan_key(num_rows, table_rows, E, best)
+                s1, s2 = best_steps
+                led.predict("plan_steps", key, s1 + s2, "steps")
+                led.predict("staging_rows", key, s2 * best.ch2, "rows")
+                led.predict("geom_time", key, best_t, "s")
         return best, best_t
     return None, t_matmul
 
@@ -986,6 +1091,7 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
                        edges=len(edge_src)):
             plan = _plan_cache_load(cache, num_rows, table_rows, geom)
         if plan is not None:
+            _ledger_note_plan(plan, len(edge_src))
             return plan
     if len(edge_src) >= (1 << 20) and native.available():
         if geom.flat:
@@ -1027,6 +1133,7 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
                                         table_rows, group_row_target, geom)
     if cache is not None:
         _plan_cache_save(cache, plan)
+    _ledger_note_plan(plan, len(edge_src))
     return plan
 
 

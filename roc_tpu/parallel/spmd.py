@@ -1769,6 +1769,20 @@ class SpmdTrainer(BaseTrainer):
                 send_cols=(gd.send_idx.shape[-1]
                            if gd.send_idx is not None else 0),
                 xch_dtype=gd.xch_dtype, xch_comp=gd.xch_comp)
+            # Ledger prediction at step-build time (host-side, outside the
+            # traced body); _obs_epoch pairs it with the per-epoch value
+            # from the metrics channel.  The channel returns this same
+            # analytic constant today, so a ratio off 1.0 means the
+            # exchange geometry the step was built for is not the one the
+            # epoch ran.
+            led = obs.get_ledger()
+            if led.attached:
+                from roc_tpu.obs.ledger import content_key
+                self._wire_key = content_key(
+                    mode="allgather" if gd.mode == "edge" else exchange,
+                    parts=self.part.num_parts, shard_nodes=S)
+                led.predict("wire_bytes", self._wire_key, wire_bytes,
+                            "bytes")
             metric_specs = {"grad_norm": P(), "param_norm": P(),
                             "wire_bytes": P(), "edges": P(PARTS_AXIS)}
             step_out_specs = (P(), P(), P(), metric_specs)

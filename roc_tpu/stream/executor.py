@@ -170,6 +170,19 @@ class StreamTrainer(BaseTrainer):
         self._logits_sink = None
         self._epoch_stream = []
         self._last_stream_stats = None
+        # Ledger predictions from the static slot geometry, paired per
+        # epoch: _fetch's accumulated bytes against the analytic sweep
+        # schedule, and the ring's overlap fraction against the design
+        # target (prefetch fully hides transfers).
+        led = obs.get_ledger()
+        if led.attached:
+            from roc_tpu.obs.ledger import content_key
+            self._wire_key = content_key(parts=self._P,
+                                         segments=self._nseg,
+                                         slots=int(cfg.stream_slots))
+            led.predict("wire_bytes", self._wire_key,
+                        self._predicted_epoch_xfer_bytes(), "bytes")
+            led.predict("overlap_frac", self._wire_key, 1.0, "frac")
         if cfg.verbose:
             budget = cfg.stream_budget_bytes()
             held = cfg.stream_slots * self.slot_bytes()
@@ -227,6 +240,33 @@ class StreamTrainer(BaseTrainer):
             for t in seg.out_tids:
                 self._stores[t] = np.zeros((PS, dims[t]), np.float32)
                 self._cots[t] = np.zeros((PS, dims[t]), np.float32)
+
+    def _predicted_epoch_xfer_bytes(self) -> int:
+        """Analytic bytes ``_fetch`` ships in one training epoch: the
+        sweep schedule ((nseg-1) fwd + nseg bwd), each sweep rotating all
+        P shards, priced from the same store shapes ``_fetch`` slices.
+        PRNG keys (a few device words per fetch) are not counted."""
+        n = self._nseg
+        total = 0
+        sweeps = [("fwd", k) for k in range(n - 1)] + \
+                 [("bwd", k) for k in range(n - 1, -1, -1)]
+        for phase, k in sweeps:
+            seg = self.segments[k]
+            for i in range(self._P):
+                b = (self._esrc[i].nbytes + self._edst[i].nbytes
+                     + self._indeg[i].nbytes)
+                if seg.head is not None:
+                    b += (len(self._tbl_idx[i])
+                          * self._stores[seg.table_tid].shape[1] * 4)
+                for t in seg.own_in_tids:
+                    b += self._S * self._stores[t].shape[1] * 4
+                if seg.is_last:
+                    b += self._S * (self._labels.shape[1] * 4 + 4)
+                if phase == "bwd" and not seg.is_last:
+                    for t in seg.out_tids:
+                        b += self._S * self._cots[t].shape[1] * 4
+                total += b
+        return int(total)
 
     def slot_bytes(self) -> int:
         """Worst-case bytes one device slot holds (table + own rows +
@@ -568,6 +608,14 @@ class StreamTrainer(BaseTrainer):
         }
         self._epoch_stream.append(
             dict(self._last_stream_stats, epoch=int(self.epoch)))
+        led = obs.get_ledger()
+        wk = getattr(self, "_wire_key", None)
+        if led.attached and wk is not None:
+            # the epoch's measured ring overlap against the _setup
+            # prediction; wire bytes pair in driver._obs_epoch off the
+            # metrics channel
+            led.measure("overlap_frac", wk, st["overlap_frac"], "frac",
+                        epoch=int(self.epoch))
         if self._metrics is not None and self._grad_acc is not None:
             from roc_tpu.obs import channel as obs_channel
             self._last_step_metrics = {

@@ -344,7 +344,21 @@ class BaseTrainer:
             except OSError:
                 jsonl = ""  # keep the in-memory registry; skip the file
         self._metrics = obs.MetricsRegistry(jsonl_path=jsonl)
+        # Calibration ledger -> this run's stream: every cost-model
+        # prediction/measurement pair (plan steps, step time, peak HBM,
+        # wire bytes, ...) lands next to the epoch records it describes.
+        # Detached again in _obs_finish.
+        obs.get_ledger().attach(self._metrics.emit)
         g = self.dataset.graph
+        # Static per-epoch roofline inputs (obs/roofline.py — the same
+        # accounting bench.py reports) for the mfu / roofline_frac fields
+        # stamped on every metrics record.  mfu is only *claimed* on the
+        # backends the PEAK_* constants describe.
+        prec = "fast" if (cfg.use_bf16
+                          or getattr(cfg, "bf16_storage", False)) else "exact"
+        self._roofline_fb = obs.roofline.model_flops_bytes(
+            self.model, g.num_nodes, g.num_edges, precision=prec)
+        self._roofline_on = jax.default_backend() in obs.roofline.TPU_BACKENDS
         # EWMA seeded from the committed kernel-budget prediction when the
         # graph shape is pinned there (binned runs); None -> measured warmup
         self.watchdog = obs.PerfWatchdog(
@@ -368,7 +382,31 @@ class BaseTrainer:
         extra = self._obs_epoch_extra(epoch)
         if extra:
             rec.update(extra)
+        if getattr(self, "_roofline_on", False):
+            # per-epoch roofline standings, same accounting bench.py
+            # stamps into artifacts (only claimed on TPU backends)
+            flops, nbytes = self._roofline_fb
+            n_dev = jax.device_count()
+            m = obs.roofline.mfu(flops, wall_s, n_dev)
+            if m is not None:
+                rec["mfu"] = round(m, 4)
+                rec["roofline_frac"] = round(obs.roofline.roofline_frac(
+                    flops, nbytes, wall_s, n_dev), 4)
         self._metrics.emit("metrics", **rec)
+        led = obs.get_ledger()
+        key = getattr(self, "_calib_key", None)
+        if led.attached and key is not None:
+            # measurement halves of _resolve_mem_plan's predictions (+ the
+            # SPMD wire-bytes analytic, keyed at step-build time)
+            led.measure("step_time", key, wall_s, "s", epoch=int(epoch))
+            wk = getattr(self, "_wire_key", None)
+            if wk is not None and rec.get("wire_bytes"):
+                led.measure("wire_bytes", wk, rec["wire_bytes"], "bytes",
+                            epoch=int(epoch))
+            hbm, src = self._peak_hbm()
+            if src == "measured":
+                led.measure("peak_memory", key, hbm, "bytes",
+                            epoch=int(epoch))
         if self.watchdog is not None:
             alert = self.watchdog.observe_epoch(epoch, wall_s)
             if alert is not None:
@@ -389,6 +427,26 @@ class BaseTrainer:
                             f"fraction {alert['stall_frac']:.3f} is "
                             f"{alert['ratio']:.2f}x its EWMA "
                             f"({alert['ewma']:.3f})")
+            # Calibration drift: the pairs joined this epoch feed the
+            # per-model ratio EWMAs.  Off the TPU backends only the
+            # structurally-exact models are judged — the time models'
+            # constants were fit on hardware, so a CPU run's step_time
+            # ratio is meaningless, not drifted.
+            for mname, ratio in led.drain_ratios():
+                if not getattr(self, "_roofline_on", False) and \
+                        mname not in ("plan_steps", "staging_rows",
+                                      "wire_bytes"):
+                    continue
+                alert = self.watchdog.observe_calibration(mname, ratio,
+                                                          epoch)
+                if alert is not None:
+                    self._metrics.emit("watchdog", **alert)
+                    if self.config.verbose:
+                        print_fn(
+                            f"# watchdog: cost model {mname} ratio EWMA "
+                            f"{alert['ewma_ratio']:.3g} left the band "
+                            f"[{alert['band_lo']:.2g}, "
+                            f"{alert['band_hi']:.2g}]")
 
     def _obs_epoch_extra(self, epoch):
         """Executor-specific per-epoch obs fields (the stream executor
@@ -402,6 +460,9 @@ class BaseTrainer:
         if self._metrics is None:
             return
         cfg = self.config
+        # the ledger outlives the run (process singleton); stop routing
+        # its records into this run's stream
+        obs.get_ledger().detach()
         verdict = self.watchdog.verdict() if self.watchdog else "off"
         self._metrics.emit(
             "train", epochs=stats.epochs, total_s=round(stats.total_s, 6),
@@ -432,6 +493,33 @@ class BaseTrainer:
         self.mem_plan = memory.plan_memory(
             self.mem_estimate, mode=cfg.mem_plan, budget_bytes=budget,
             offload_executed=getattr(cfg, "stream", False))
+        # Ledger predictions made once, before the first epoch: the
+        # estimator's all-KEEP step time and the memory plan's peak —
+        # paired per epoch in _obs_epoch (wall clock / device-reported
+        # peak) under one content key for the run's shard shape.
+        led = obs.get_ledger()
+        if led.attached:
+            from roc_tpu.obs.ledger import content_key
+            self._calib_key = content_key(rows=self.mem_estimate.rows,
+                                          edges=self.mem_estimate.edges)
+            led.predict("step_time", self._calib_key,
+                        self.mem_estimate.base_step_s, "s")
+            led.predict("peak_memory", self._calib_key,
+                        self.mem_plan.predicted_peak_bytes, "bytes")
+            if getattr(cfg, "megafuse", False):
+                # the megakernel's train-step HBM claim, on the record —
+                # pairable only against hardware counters (unpaired off
+                # device, which the calibration report counts as such)
+                from roc_tpu.models.model import mega_matches
+                from roc_tpu.ops.pallas import binned as B
+                rows = self.mem_estimate.rows
+                tot = sum(B.predicted_trainstep_hbm_bytes(
+                    rows, m["linear"].attrs["in_dim"],
+                    m["linear"].attrs["out_dim"], mega_bwd=True)
+                    for m in mega_matches(self.model).values())
+                if tot:
+                    led.predict("hbm_bytes", self._calib_key, tot,
+                                "bytes")
         if cfg.verbose and (cfg.mem_plan != "keep" or budget):
             print(f"# {self.mem_plan.summary()}")
 

@@ -133,6 +133,16 @@ _POSITIVE = {
                         "    return acc\n"],
     "closure-capture": ["fns = []\nfor i in range(3):\n"
                         "    fns.append(lambda: i + 1)\n"],
+    "unledgered-prediction": [
+        # ad-hoc prediction dict key
+        "row = {'predicted_step_s': 0.1, 'nodes': 4}\n",
+        # measurement-shaped field emitted around the ledger
+        "def f(reg, t):\n"
+        "    reg.emit('epoch', measured_step_s=t)\n",
+        # record_event kwarg spelling
+        "def f(buf, t):\n"
+        "    buf.record_event('probe', predicted_time_s=t)\n",
+    ],
 }
 
 _CLEAN = [
@@ -152,7 +162,28 @@ _CLEAN = [
     "import time\ndef run(fn, x):\n    t0 = time.perf_counter()\n"
     + "    x = fn(x)\n" * 14
     + "    x.block_until_ready()\n    return time.perf_counter() - t0\n",
+    # prediction-FLAVORED names that don't match the prefix are fine, as
+    # are plain emit kwargs without the predicted_/measured_ shape
+    "row = {'prediction': 0.1, 'measure': 2}\n"
+    "def f(reg, t):\n    reg.emit('epoch', step_s=t)\n",
 ]
+
+
+def test_lint_unledgered_prediction_obs_exempt():
+    """roc_tpu/obs/ IS the ledger — the rule must not flag the sanctioned
+    sink itself (mirrors the raw-timing exemption)."""
+    src = "row = {'predicted_step_s': 0.1}\n"
+    assert lint.lint_source(src, "roc_tpu/obs/ledger.py") == []
+    assert any(f.rule == "unledgered-prediction"
+               for f in lint.lint_source(src, "roc_tpu/train/manager.py"))
+
+
+def test_lint_unledgered_prediction_waiver():
+    src = ("stamp = {\n"
+           "    # roclint: allow(unledgered-prediction)\n"
+           "    'predicted_peak_bytes': 1,\n"
+           "}\n")
+    assert lint.lint_source(src) == []
 
 
 @pytest.mark.parametrize("rule", sorted(_POSITIVE))

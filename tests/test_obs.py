@@ -420,3 +420,241 @@ def test_lint_raw_timing_exemptions():
         "t0 = time.perf_counter()",
         "t0 = time.perf_counter()  # roclint: allow(raw-timing)")
     assert lint.lint_source(waived, "roc_tpu/train/x.py") == []
+
+
+# -- calibration ledger ----------------------------------------------------
+
+def _fresh_ledger():
+    from roc_tpu.obs.ledger import CalibrationLedger
+    return CalibrationLedger()
+
+
+def test_ledger_content_key_is_order_insensitive():
+    from roc_tpu.obs.ledger import content_key
+    assert content_key(rows=4, edges=9) == content_key(edges=9, rows=4)
+    assert content_key(rows=4, edges=9) == "edges=9|rows=4"
+
+
+def test_ledger_predict_measure_join_and_ratio():
+    led = _fresh_ledger()
+    led.predict("plan_steps", "e=9|n=4", 100, "steps")
+    r = led.measure("plan_steps", "e=9|n=4", 150, "steps")
+    assert r == pytest.approx(1.5)
+    kinds = [k for k, _ in led.records]
+    assert kinds == ["prediction", "measurement"]
+    meas = led.records[-1][1]
+    assert meas["predicted"] == 100.0 and meas["ratio"] == pytest.approx(1.5)
+    # a different content key does NOT join
+    assert led.measure("plan_steps", "e=7|n=4", 150, "steps") is None
+    # re-predicting overwrites: the join pairs against the newest
+    led.predict("plan_steps", "e=9|n=4", 300, "steps")
+    assert led.measure("plan_steps", "e=9|n=4", 150, "steps") \
+        == pytest.approx(0.5)
+
+
+def test_ledger_emission_is_gated_on_attach(tmp_path):
+    from roc_tpu.obs.metrics import MetricsRegistry
+    led = _fresh_ledger()
+    led.predict("x", "k=1", 1.0, "s")          # detached: no sink, no error
+    reg = MetricsRegistry(jsonl_path=str(tmp_path / "m.jsonl"))
+    led.attach(reg.emit)
+    led.predict("step_time", "k=1", 2.0, "s")
+    led.measure("step_time", "k=1", 3.0, "s")
+    led.detach()
+    led.measure("step_time", "k=1", 9.0, "s")  # detached again: not emitted
+    kinds = [k for k, _ in reg.records]
+    assert kinds == ["prediction", "measurement"]
+
+
+def test_ledger_drain_ratios_feeds_and_clears():
+    led = _fresh_ledger()
+    led.predict("m", "k", 2.0, "s")
+    led.measure("m", "k", 4.0, "s")
+    assert led.drain_ratios() == [("m", 2.0)]
+    assert led.drain_ratios() == []            # drained
+
+
+def test_ledger_validate_and_offline_join():
+    from roc_tpu.obs.ledger import calibration_report, join, validate_records
+    stream = [
+        {"type": "prediction", "model": "m", "key": "k", "value": 2.0,
+         "units": "s"},
+        {"type": "measurement", "model": "m", "key": "k", "value": 3.0,
+         "units": "s"},                        # unpaired in-stream: re-joins
+        {"type": "metrics", "wall_s": 0.1},    # foreign kinds pass through
+    ]
+    assert validate_records(stream) == []
+    joined = join(stream)
+    assert joined[0]["ratio"] == pytest.approx(1.5)
+    rep = calibration_report(stream)
+    assert rep["models"]["m"]["pairs"] == 1
+    assert rep["models"]["m"]["ratio_mean"] == pytest.approx(1.5)
+    # broken records are named, not crashed on
+    bad = [{"type": "measurement", "model": "m", "key": "k", "value": 1.0,
+            "units": "s", "ratio": 2.0}]       # ratio without predicted
+    assert validate_records(bad)
+
+
+def test_watchdog_calibration_drift_fires_and_quiet():
+    wd = PerfWatchdog(warmup=2)
+    # in-band ratios never alert, regardless of count
+    for _ in range(6):
+        assert wd.observe_calibration("plan_steps", 1.1) is None
+    # out-of-band model: warmup pairs build the EWMA silently, then fire
+    assert wd.observe_calibration("step_time", 5.0, epoch=0) is None
+    assert wd.observe_calibration("step_time", 5.0, epoch=1) is None
+    alert = wd.observe_calibration("step_time", 5.0, epoch=2)
+    assert alert is not None and alert["kind"] == "calibration-drift"
+    assert alert["model"] == "step_time"
+    assert wd.verdict() == "calibration-drift"
+    # a non-positive ratio is a broken pair, not drift
+    assert wd.observe_calibration("peak_memory", 0.0) is None
+
+
+def test_report_renders_unknown_span_and_alert_kinds():
+    """The report is generic over span names and alert kinds: a kind
+    invented after this renderer was written must show up, not fall into
+    some slow-epoch-shaped else branch."""
+    trace = {"traceEvents": [
+        {"name": "never_seen_span", "ph": "X", "ts": 0, "dur": 1500.0,
+         "pid": 1, "tid": 1}]}
+    lines = "\n".join(obs_report.summarize_trace(trace))
+    assert "never_seen_span" in lines
+    records = [
+        {"type": "somefuturekind", "x": 1},
+        {"type": "watchdog", "kind": "flux-capacitor", "epoch": 3,
+         "overcharge": 1.21},
+    ]
+    txt = "\n".join(obs_report.summarize_metrics(records))
+    assert "somefuturekind x1" in txt          # census counts unknown kinds
+    assert "flux-capacitor" in txt
+    assert "overcharge=1.21" in txt            # numeric fields render generically
+
+
+# -- Prometheus export format ----------------------------------------------
+
+def test_prometheus_labeled_gauges_and_escaping(tmp_path):
+    from roc_tpu.obs.metrics import MetricsRegistry
+    reg = MetricsRegistry(jsonl_path="")
+    reg.emit("epoch", wall_s=0.25)
+    reg.set_gauge("calibration_ratio", 1.5, model="plan_steps")
+    # label values with every escape-worthy character
+    reg.set_gauge("calibration_ratio", 2.0, model='we"ird\\mo\ndel')
+    path = str(tmp_path / "prom.txt")
+    assert reg.write_prometheus(path)
+    text = open(path, encoding="utf-8").read()
+    assert 'roc_calibration_ratio{model="plan_steps"} 1.5' in text
+    assert r'model="we\"ird\\mo\nmodel"' not in text  # name kept intact...
+    assert r'we\"ird\\mo\ndel' in text                # ...escaped, not mangled
+    assert "roc_epoch_wall_s 0.25" in text
+    assert "\n\n" not in text.strip()
+
+
+def test_prometheus_skips_nonfinite_and_updates_latest(tmp_path):
+    from roc_tpu.obs.metrics import MetricsRegistry
+    reg = MetricsRegistry(jsonl_path="")
+    reg.emit("epoch", loss=float("nan"), wall_s=float("inf"), ok=3.0)
+    reg.set_gauge("calibration_ratio", float("nan"), model="m")
+    path = str(tmp_path / "prom.txt")
+    assert reg.write_prometheus(path)
+    text = open(path, encoding="utf-8").read()
+    assert "nan" not in text and "inf" not in text
+    assert "roc_epoch_ok 3" in text
+    # a later finite value for the same series replaces the skip
+    reg.emit("epoch", loss=0.5)
+    reg.set_gauge("calibration_ratio", 1.25, model="m")
+    assert reg.write_prometheus(path)
+    text = open(path, encoding="utf-8").read()
+    assert "roc_epoch_loss 0.5" in text
+    assert 'roc_calibration_ratio{model="m"} 1.25' in text
+
+
+def test_measurement_records_auto_export_calibration_gauge(tmp_path):
+    """The registry turns ledger measurement records into per-model
+    roc_calibration_ratio{model=...} gauges without extra wiring."""
+    from roc_tpu.obs.metrics import MetricsRegistry
+    led = _fresh_ledger()
+    reg = MetricsRegistry(jsonl_path="")
+    led.attach(reg.emit)
+    led.predict("wire_bytes", "k=1", 100, "B")
+    led.measure("wire_bytes", "k=1", 110, "B")
+    led.detach()
+    path = str(tmp_path / "prom.txt")
+    assert reg.write_prometheus(path)
+    text = open(path, encoding="utf-8").read()
+    assert 'roc_calibration_ratio{model="wire_bytes"} 1.1' in text
+
+
+# -- perf ledger (tools/perf_ledger.py) ------------------------------------
+
+def _perf_ledger_mod():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "perf_ledger", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "perf_ledger.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_rounds(root, rounds):
+    for n, env in rounds:
+        with open(os.path.join(root, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump(env, f)
+
+
+def test_perf_ledger_fold_and_schema(tmp_path):
+    pl = _perf_ledger_mod()
+    root = str(tmp_path)
+    _write_rounds(root, [
+        (1, {"n": 1, "cmd": "python bench.py", "rc": 1,
+             "tail": "RuntimeError: tunnel wedged",
+             "parsed": {"metric": "epoch_time", "value": None, "unit": "s",
+                        "error": "RuntimeError: tunnel wedged"}}),
+        (2, {"n": 2, "cmd": "python bench.py", "rc": 0, "tail": "",
+             "parsed": {"metric": "epoch_time", "value": 0.7, "unit": "s",
+                        "mfu": 0.002, "roofline_frac": 0.06,
+                        "fusion": "mega"}}),
+    ])
+    with open(os.path.join(root, "BENCH_LAST_HW.json"), "w") as f:
+        json.dump({"metric": "epoch_time", "value": 0.7, "unit": "s",
+                   "measured_at": "2026-08-02T00:00:00Z"}, f)
+    assert pl.check(root) == []
+    traj = pl.fold(root)
+    assert [r["round"] for r in traj["rounds"]] == [1, 2]
+    assert traj["rounds"][0]["error"]           # failed round keeps receipt
+    assert traj["rounds"][1]["mfu"] == 0.002
+    assert traj["last_hw"]["value"] == 0.7
+    md = pl.markdown(traj)
+    assert "| 2 | 0 | epoch_time | 0.7 | s |" in md
+    assert "fusion=mega" in md                  # leg-distinguishing stamps
+    assert "tunnel wedged" in md                # failure line is data
+
+
+def test_perf_ledger_check_flags_malformed(tmp_path):
+    pl = _perf_ledger_mod()
+    root = str(tmp_path)
+    _write_rounds(root, [
+        (1, {"n": 7, "cmd": "x", "rc": 0, "tail": "",   # n != filename
+             "parsed": {"metric": "m", "unit": "s"}}),  # value missing,
+    ])                                                  # no error either
+    errs = pl.check(root)
+    assert any("n=7" in e for e in errs)
+    assert any("parsed.value" in e for e in errs)
+
+
+def test_perf_ledger_md_block_is_idempotent(tmp_path):
+    pl = _perf_ledger_mod()
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "docs"))
+    with open(os.path.join(root, "docs", "PERF.md"), "w") as f:
+        f.write("# PERF\n\nhand-written content\n")
+    _write_rounds(root, [(1, {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+                              "parsed": {"metric": "m", "value": 1.0,
+                                         "unit": "s"}})])
+    table = pl.markdown(pl.fold(root))
+    assert pl.update_perf_md(table, root)
+    assert pl.update_perf_md(table, root)       # second run must replace
+    text = open(os.path.join(root, "docs", "PERF.md")).read()
+    assert text.count(pl.MD_BEGIN) == 1
+    assert "hand-written content" in text       # never clobbers prose

@@ -136,6 +136,18 @@ ROC_OBS=1 ROC_OBS_DIR=/tmp/roc_obs_hw ROC_BENCH_EPOCHS=5 \
     timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
 timeout 120 python -m roc_tpu.obs report -dir /tmp/roc_obs_hw 2>&1 \
     | tee -a "$LOG"
+timeout 120 python -m roc_tpu.obs calibration -dir /tmp/roc_obs_hw 2>&1 \
+    | tee -a "$LOG"
+
+note "3h. per-kernel microbench on the chip: times every Pallas variant"
+note "    (two-pass p1/p2, flat, fused, mega fwd/bwd, matmul) in isolation"
+note "    across the geometry presets and COMMITS the measured table into"
+note "    tools/kernel_budgets.json — the balance cost model and"
+note "    choose_geometry warm-start from it (interpret=false tables only;"
+note "    the CPU table in the repo is schema ballast, never trusted)."
+note "    Review + commit the kernel_budgets.json diff after the window."
+KB_DEVICE=1 KB_REPS=5 timeout 1800 \
+    python tools/kernel_bench.py --update 2>&1 | tail -20 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 4 ]; then

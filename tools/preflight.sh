@@ -35,6 +35,12 @@ echo "== kernel step budgets =="
 timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python tools/check_kernel_budgets.py || {
     echo "preflight: kernel step budgets RED" >&2; exit 1; }
+# Bench-artifact schema: the BENCH_rNN.json round receipts feed the
+# perf-ledger fold (BENCH_TRAJECTORY.json / docs/PERF.md table); a field
+# rename in the driver would break that join silently months later.
+echo "== bench artifact schema =="
+timeout -k 10 60 python tools/perf_ledger.py --check || {
+    echo "preflight: bench artifact schema RED" >&2; exit 1; }
 
 # Obs gate: the observability layer holds its own contracts — tracer
 # span nesting + Chrome-trace schema validity, watchdog fires on an
@@ -43,6 +49,15 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
 echo "== obs selftest =="
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m roc_tpu.obs selftest || {
     echo "preflight: obs selftest RED" >&2; exit 1; }
+
+# Calibration gate: the prediction/measurement ledger must actually pair
+# on a tiny CPU run — >= 5 cost models joined by content key, each inside
+# its sanity band.  This is the wiring proof for the flight recorder: a
+# renamed field or a broken content key shows up here, not on the chip.
+echo "== calibration selftest =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m roc_tpu.obs calibration --selftest || {
+    echo "preflight: calibration selftest RED" >&2; exit 1; }
 
 # Memory-plan determinism gate: the same config must produce a
 # byte-identical plan JSON (the plan participates in the step cache key —
