@@ -610,6 +610,23 @@ def run():
             "watchdog_alerts": list(wd.alerts) if wd is not None else [],
             "span_types": sorted(obs.get_tracer().span_types()),
         }
+    # Tuned-tier status (roc_tpu/tune): whether a tuned store was in
+    # reach of this run's choose_geometry calls, and how it was produced.
+    # ROC_AUTOTUNE=1 makes the run sweep+persist before its plan builds.
+    try:
+        from roc_tpu.tune import store as _tstore
+        _tp = _tstore.tuned_store_path()
+        _doc = _tstore.load_store(_tp) if _tp else None
+        result["tuned"] = {
+            "autotune": bool(getattr(trainer.config, "autotune", False)),
+            "store": _tp or "",
+            "entries": len(_doc["entries"]) if _doc else 0,
+            "source": ("surrogate" if _doc.get("interpret", True)
+                       else "device") if _doc else "",
+        }
+    except Exception:
+        result["tuned"] = {"autotune": False, "store": "", "entries": 0,
+                           "source": ""}
     if (result["platform"] not in ("cpu",) and result["value"] is not None
             and SCALE == 1.0 and PRECISION == "fast" and MODEL == "gcn"
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0

@@ -61,6 +61,17 @@ preceding line):
     serialize already-ledgered values for human-facing JSON, they are
     not new prediction sites.
 
+``hand-rolled-geometry``
+    A ``Geometry(...)`` constructor call outside the sanctioned sites —
+    the kernel module that owns the presets
+    (``roc_tpu/ops/pallas/binned.py``), the plan builders
+    (``roc_tpu/ops/aggregate.py``), the autotuner (``roc_tpu/tune/``),
+    and tests.  A hand-rolled geometry bypasses both the analytic cost
+    model and the persisted tuned tier, silently pinning a config the
+    sweep may already have beaten; go through ``GEOM_PRESETS`` /
+    ``choose_geometry``, or waive with a rationale (forced-A/B sweep
+    harnesses do).
+
 A *jitted context* is a function that is (a) decorated with ``jax.jit``
 / ``jax.shard_map`` / ``jax.custom_vjp`` (directly or via ``partial``),
 (b) passed by name to a tracing entry point (``jax.jit``, ``shard_map``,
@@ -119,6 +130,18 @@ _RAW_TIMING_EXEMPT_DIR = os.path.join("roc_tpu", "obs") + os.sep
 # (the unledgered-prediction rule); the ledger itself (roc_tpu/obs/)
 # is exempt — it *is* the sanctioned sink for these.
 _PRED_KEY_RE = re.compile(r"^(predicted|measured)_")
+# Paths allowed to construct Geometry(...) literals (the
+# hand-rolled-geometry rule): the kernel module that defines it and its
+# presets, the plan builders that thread it, the autotuner whose whole
+# job is manufacturing candidates, and tests.
+_GEOM_EXEMPT_SUFFIXES = (
+    os.path.join("roc_tpu", "ops", "pallas", "binned.py"),
+    os.path.join("roc_tpu", "ops", "aggregate.py"),
+)
+_GEOM_EXEMPT_DIRS = (
+    os.path.join("roc_tpu", "tune") + os.sep,
+    "tests" + os.sep,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,6 +263,7 @@ class _FileLint:
         self._rule_closure_capture()
         self._rule_remat()
         self._rule_unledgered_prediction()
+        self._rule_hand_rolled_geometry()
         return self.findings
 
     def _rule_jit_scope(self, roots: Set[int]):
@@ -424,6 +448,30 @@ class _FileLint:
                            f"planner's budget accounting; route remat "
                            f"through roc_tpu/memory (-mem-plan) or waive "
                            f"with a rationale")
+
+    def _rule_hand_rolled_geometry(self):
+        """Geometry(...) literals outside the sanctioned construction
+        sites.  A hand-rolled geometry bypasses choose_geometry's cost
+        model AND the tuned tier (roc_tpu/tune), so it silently pins a
+        config the sweep may already have beaten — route through the
+        GEOM_PRESETS / choose_geometry / the tuner, or waive with a
+        rationale (forced A/B harnesses do)."""
+        p = self.path.replace("/", os.sep)
+        if any(p.endswith(s) for s in _GEOM_EXEMPT_SUFFIXES) or \
+                any(d in p for d in _GEOM_EXEMPT_DIRS) or \
+                os.path.basename(p).startswith("test_"):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            head = _call_head(node)
+            if head and (head == "Geometry"
+                         or head.endswith(".Geometry")):
+                self._flag(node, "hand-rolled-geometry",
+                           f"{head}(...) hand-rolls a kernel geometry, "
+                           f"bypassing choose_geometry and the tuned "
+                           f"tier; use GEOM_PRESETS/choose_geometry or "
+                           f"waive with a rationale")
 
     def _rule_unledgered_prediction(self):
         """predicted_*/measured_* fields minted outside the ledger."""

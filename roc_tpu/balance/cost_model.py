@@ -47,7 +47,12 @@ PRIOR_WEIGHT = 0.1
 # (tools/kernel_bench.py's table, binned.measured_calibration) rather
 # than hand-fit constants: a measured prior has earned more pull, so
 # early rounds lean on it harder and reach a trustworthy fit in fewer
-# probes (tests/test_balance.py pins the probes-to-R^2 win).
+# probes (tests/test_balance.py pins the probes-to-R^2 win).  The
+# autotuner's refit stage (roc_tpu/tune/refit.py, `python -m
+# roc_tpu.tune --device --refit --update`) is the second producer of
+# that measured table: its least-squares over on-device sweep trials
+# re-solves the same rates kernel_bench times directly, under the same
+# interpret-refusal contract, so this weight applies to either source.
 MEASURED_PRIOR_WEIGHT = 0.5
 
 

@@ -360,7 +360,9 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
 
     ROC_BINNED_GEOM=<preset name> (binned.GEOM_PRESETS) overrides the
     forward auto-choice for hardware A/B runs that must isolate one
-    variable (tools/hw_revalidate.sh step 4c)."""
+    variable (tools/hw_revalidate.sh step 4c).  A forced preset builds
+    with ``tuned_ok=False``: an A/B run must get exactly the geometry it
+    named even when the tuned tier disagrees (round 12)."""
     import os
     from roc_tpu.ops.pallas.binned import (GEOM_PRESETS, Geometry,
                                            _default_geom,
@@ -382,9 +384,9 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
                                fuse_linear=fuse)
         return g or _default_geom()
 
+    forced_env = os.environ.get("ROC_BINNED_GEOM", "")
     fwd_geom = pick(fwd_spec, edge_src, edge_dst, num_rows, table_rows,
-                    fuse=fuse_linear,
-                    forced=os.environ.get("ROC_BINNED_GEOM", ""))
+                    fuse=fuse_linear, forced=forced_env)
     es, ed = np.asarray(edge_src), np.asarray(edge_dst)
     mm = None
     if getattr(fwd_geom, "hub_minc", 0):
@@ -395,15 +397,17 @@ def build_binned_plans(edge_src: np.ndarray, edge_dst: np.ndarray,
             mm = build_aggregate_plans(ts[o], td[o], num_rows, table_rows)
             es, ed = es[keep], ed[keep]
     bwd_geom = pick(bwd_spec, ed, es, table_rows, num_rows,
-                    fuse=fuse_linear,
-                    forced=os.environ.get("ROC_BINNED_GEOM", ""))
+                    fuse=fuse_linear, forced=forced_env)
     if getattr(bwd_geom, "hub_minc", 0):
         # the split happened (once) on the forward cells; the bwd binned
         # plan covers exactly the transposed dense edges
         bwd_geom = bwd_geom._replace(hub_minc=0)
+    tuned_ok = not forced_env
     return BinnedPlans(
-        fwd=build_binned_plan(es, ed, num_rows, table_rows, geom=fwd_geom),
-        bwd=build_binned_plan(ed, es, table_rows, num_rows, geom=bwd_geom),
+        fwd=build_binned_plan(es, ed, num_rows, table_rows, geom=fwd_geom,
+                              tuned_ok=tuned_ok),
+        bwd=build_binned_plan(ed, es, table_rows, num_rows, geom=bwd_geom,
+                              tuned_ok=tuned_ok),
         mm=mm)
 
 

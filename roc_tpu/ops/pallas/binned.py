@@ -907,6 +907,52 @@ def _ledger_note_plan(plan: "BinnedPlan", num_edges: int) -> None:
     led.measure("staging_rows", key, G * C2 * g.ch2, "rows")
 
 
+def _tuned_geometry(edge_src, edge_dst, num_rows, table_rows,
+                    storage_dtype, fuse_linear):
+    """The tuned-tier lookup (roc_tpu/tune/store.py), failure-isolated:
+    a missing/invalid store, ROC_NO_TUNED=1, or any import problem reads
+    as 'no tuned entry' and the analytic model stays in charge.  Lazy
+    import — tune imports this module at load time."""
+    if os.environ.get("ROC_NO_TUNED"):
+        return None
+    try:
+        from roc_tpu.tune import store as _tstore
+        g, _ = _tstore.lookup(edge_src, edge_dst, num_rows, table_rows,
+                              storage_dtype=storage_dtype,
+                              fuse_linear=fuse_linear)
+        return g
+    except Exception:
+        return None
+
+
+def _priced_tuned(edge_src, edge_dst, num_rows, table_rows, E, geom,
+                  fuse_linear):
+    """Price a tuned winner through the SAME exact-schedule model the
+    analytic path uses (so the returned seconds stay comparable and the
+    balancer's consumers see one currency) and emit the same calibration
+    predictions a modeled win would — a tuned pick is still a prediction
+    the built plan and the hardware get to grade."""
+    cblk, cbin, cnt = _cell_stats(edge_src, edge_dst, geom.sb, geom.rb)
+    padded, s1, s2 = _plan_steps(cblk, cbin, cnt, geom, num_rows,
+                                 table_rows, E)
+    t = _binned_cost_model(padded, geom, steps1=s1, steps2=s2)
+    if fuse_linear:
+        fs = _fused_sched_stats(cblk, cbin, cnt, geom, num_rows,
+                                table_rows, E)
+        if fs is not None:
+            t *= fs[0] / max(s1 + s2, 1)
+        else:
+            t += (2 * num_rows * _MODEL_H * 4 / _HBM_BW
+                  + -(-num_rows // 512) * _CHUNK_OVERHEAD_S)
+    led = _get_ledger()
+    if led.attached:
+        key = _plan_key(num_rows, table_rows, E, geom)
+        led.predict("plan_steps", key, s1 + s2, "steps")
+        led.predict("staging_rows", key, s2 * geom.ch2, "rows")
+        led.predict("geom_time", key, t, "s")
+    return geom, t
+
+
 def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
                     num_rows: int, table_rows: int,
                     candidates=None, force: bool = False,
@@ -930,6 +976,15 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     binned candidate — the explicit `-aggr-backend binned` path, where
     falling back to the dense default geometry on a sparse graph would
     build a multi-GB plan.
+
+    TUNED TIER (round 12): before any modeling, the auto path
+    (``candidates is None``) consults the content-keyed tuned.json the
+    autotuner persists alongside the plan cache (roc_tpu/tune) — a sweep
+    winner recorded for this exact graph content + (storage, fuse)
+    variant is returned outright, priced through the same exact-schedule
+    model so the seconds stay comparable.  ROC_NO_TUNED=1 disables the
+    tier; explicit candidate lists (forced A/Bs, the tuner's own trials)
+    never consult it.
 
     ``storage_dtype``: "fp32" (default) or "bf16" — the feature-storage
     dtype the trainer will run.  bf16 storage adds the 16-row bf16-unit
@@ -960,6 +1015,17 @@ def choose_geometry(edge_src: np.ndarray, edge_dst: np.ndarray,
     if storage_dtype not in ("fp32", "bf16"):
         raise ValueError(f"storage_dtype={storage_dtype!r}: must be "
                          f"'fp32' or 'bf16'")
+    # Tuned tier (round 12, roc_tpu/tune): a persisted sweep winner for
+    # this exact graph content + variant outranks the analytic model.
+    # Only the AUTO path consults it — an explicit candidate list is a
+    # forced A/B (kernel_bench, the tuner's own trials) and must never
+    # be diverted to the thing it is measuring against.
+    if candidates is None:
+        tg = _tuned_geometry(edge_src, edge_dst, num_rows, table_rows,
+                             storage_dtype, fuse_linear)
+        if tg is not None:
+            return _priced_tuned(edge_src, edge_dst, num_rows,
+                                 table_rows, E, tg, fuse_linear)
     cands = list(candidates) if candidates is not None else \
         [_default_geom(), GEOM_WIDE, GEOM_MID, GEOM_MID_WIDE,
          GEOM_SPARSE, GEOM_SPARSE_WIDE, GEOM_XSPARSE,
@@ -1068,7 +1134,8 @@ def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
 def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
                       num_rows: int, table_rows: int,
                       group_row_target: int = _GROUP_ROW_TARGET,
-                      geom: Geometry = None) -> BinnedPlan:
+                      geom: Geometry = None,
+                      tuned_ok: bool = True) -> BinnedPlan:
     """Host-side schedule: sort, slot-pad, and position every edge for both
     phases.  Big edge lists take the native C++ counting-sort builder
     (O(E), ~14x the NumPy lexsort path: 2.0 s vs 27.3 s at Reddit scale,
@@ -1079,9 +1146,27 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
     At 100M-edge scale even the native build is minutes of host work per
     direction, so built plans are cached on disk keyed by the edge-list
     content and the full schedule-shaping input (geometry incl. group
-    target, shape) — see _plan_cache_path."""
+    target, shape) — see _plan_cache_path.
+
+    PLAN-CACHE HYGIENE (round 12): with ``tuned_ok`` (the default), a
+    requested geometry that disagrees with a NEWER tuned-tier winner for
+    this same edge content warns once and yields to the tuned config —
+    the cache keys on the geometry, so without this check a plan cached
+    before a sweep would keep hitting at its stale geometry forever.
+    ``tuned_ok=False`` is the forced-A/B escape hatch (kernel_bench, the
+    tuner's own trials, ROC_BINNED_GEOM overrides): build exactly what
+    was asked."""
     from roc_tpu import native
     geom = (geom or _default_geom()).check()
+    if tuned_ok and not os.environ.get("ROC_NO_TUNED"):
+        try:
+            from roc_tpu.tune import store as _tstore
+            tg = _tstore.stale_plan_geom(edge_src, edge_dst, num_rows,
+                                         table_rows, geom)
+        except Exception:
+            tg = None
+        if tg is not None:
+            geom = tg.check()
     if geom.grt:
         group_row_target = geom.grt
     cache = _plan_cache_path(edge_src, edge_dst, num_rows, table_rows,

@@ -99,6 +99,15 @@ class Config:
                                   # reaches HBM.  Opt-in; off keeps every
                                   # program byte-identical.  Runtime kill
                                   # switch: ROC_NO_MEGAFUSE=1
+    autotune: bool = False        # geometry autotuner (roc_tpu/tune): sweep
+                                  # this graph's kernel-config space before
+                                  # the plan builds and persist the winners
+                                  # in the content-keyed tuned store that
+                                  # choose_geometry / build_binned_plan
+                                  # consult.  Surrogate (cost-model) trials
+                                  # off-hardware, real timed trials on TPU.
+                                  # Kill switch for consumption:
+                                  # ROC_NO_TUNED=1
     lazy_load: bool = False       # memmap features / defer one-hot labels
                                   # (sharded host loading for huge graphs)
     halo: bool = True             # v1 halo exchange vs v0 all_gather
@@ -246,6 +255,11 @@ class Config:
         # runtime kill switch checked at dispatch, not a config field.
         if env.get("ROC_MEGAFUSE"):
             self.megafuse = env["ROC_MEGAFUSE"] == "1"
+        # ROC_AUTOTUNE mirrors -autotune for driverless entry points
+        # (bench.py, hw_revalidate's sweep leg); ROC_NO_TUNED stays the
+        # runtime kill switch on tuned-store CONSUMPTION.
+        if env.get("ROC_AUTOTUNE"):
+            self.autotune = env["ROC_AUTOTUNE"] == "1"
         if self.bf16_storage and self.aggregate_precision == "exact":
             # the binned flat bf16 unit and the bf16 wire both round where
             # "exact" promises fp32 end to end — refuse the contradiction
@@ -332,6 +346,10 @@ def parse_args(argv: List[str]) -> Config:
                    default="nearest", choices=["nearest", "stochastic"])
     p.add_argument("-bf16-exchange", dest="bf16_exchange",
                    default="plain", choices=["plain", "compensated"])
+    p.add_argument("-autotune", dest="autotune", action="store_true",
+                   help="sweep the kernel-config space for this graph and "
+                        "persist the winners in the tuned store "
+                        "(roc_tpu/tune) before building plans")
     p.add_argument("-megafuse", dest="megafuse", action="store_true",
                    help="fuse aggregate->linear(->relu) layers into one "
                         "Pallas megakernel (binned-flat backend)")

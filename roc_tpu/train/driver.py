@@ -136,11 +136,41 @@ def resolve_gat_backend(backend: str, num_edges: int) -> str:
     return "xla" if backend == "xla" else "plan"
 
 
+def maybe_autotune(edge_src, edge_dst, num_rows: int, table_rows: int,
+                   storage_dtype: str = "fp32", fuse_linear: bool = False,
+                   watchdog=None, log=None):
+    """-autotune / ROC_AUTOTUNE: sweep this graph's kernel-config space
+    (roc_tpu/tune) and persist the winners in the tuned store BEFORE the
+    plan builds below, so choose_geometry / build_binned_plan pick them
+    up on this very run.  Surrogate trials off-hardware, real timed
+    trials on TPU.  Failure-isolated: a tuner error must never take the
+    training run down with it."""
+    import numpy as np
+    try:
+        from roc_tpu.tune import autotune_graph
+        with obs.span("autotune", edges=int(np.asarray(edge_src).size)):
+            return autotune_graph(
+                np.asarray(edge_src), np.asarray(edge_dst), num_rows,
+                table_rows, storage_dtype=storage_dtype,
+                fuse_linear=fuse_linear,
+                device=jax.default_backend() in ("tpu", "axon"),
+                watchdog=watchdog, log=log)
+    except Exception as e:      # pragma: no cover - defensive
+        import warnings
+        warnings.warn(f"autotune failed ({e}); continuing untuned")
+        return None, None
+
+
 def dense_graph_data(graph, backend: str = "xla",
                      precision: str = "exact",
                      gat_backend: str = "xla",
                      storage_dtype: str = "fp32",
-                     megafuse: bool = False) -> DenseGraphData:
+                     megafuse: bool = False,
+                     autotune: bool = False) -> DenseGraphData:
+    if autotune:
+        maybe_autotune(graph.col_idx, graph.dst_idx, graph.num_nodes,
+                       graph.num_nodes, storage_dtype=storage_dtype,
+                       fuse_linear=megafuse)
     backend, geom = resolve_backend_geom(
         backend, graph.num_edges, graph.num_nodes, graph.num_nodes,
         graph.col_idx, graph.dst_idx, storage_dtype=storage_dtype,
@@ -769,7 +799,8 @@ class Trainer(BaseTrainer):
             ds.graph, backend, self.config.aggregate_precision,
             gat_backend=self._gat_backend(),
             storage_dtype="bf16" if self.config.bf16_storage else "fp32",
-            megafuse=self.config.megafuse)
+            megafuse=self.config.megafuse,
+            autotune=self.config.autotune)
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.onehot_labels(), jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)

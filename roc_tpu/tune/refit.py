@@ -1,0 +1,185 @@
+"""Refit: re-solve the cost-model rate constants from trial records.
+
+The analytic model prices one aggregation pass as
+
+    t = max(mac1, s1*chunk_s) + dma_units*slot_dma_s + max(mac2, s2*chunk_s)
+
+(surrogate.analytic_seconds — the parameterized mirror of binned's
+``_binned_cost_model``), and the matmul backend as ``chunks *
+mm_chunk_s``.  Each trial record carries its measured seconds AND its
+schedule facts (step counts, the DMA regressor, flat/non-flat) — so
+recovering the rates is a small linear least-squares, not a re-derive:
+
+    t_i = chunk_s * steps_i + slot_dma_s * dma_nonflat_i
+                            + flat_dma_s * dma_flat_i
+
+over the overhead-bound, knob-default aggregation trials (MAC-bound
+trials are excluded: their max() clamps break linearity in the rate;
+knob-variant trials are excluded: the screen's priors would contaminate
+the solve).  When the sweep's PROBE records are present they are the
+whole calibration set — the halving's survivors cluster around the
+winner, leaving steps and dma_units nearly collinear, while the probes
+are designed pairs that pull those columns apart (search.REFIT_PROBES).  ``flat_dma_s`` is the flat staging-DMA term solved as its
+own column — same nominal constant today, but the hardware fit is
+allowed to disagree (the flat schedule's size-classed copies are a
+different DMA population than the slot schedule's, which is exactly the
+standing re-fit question in the ROADMAP).  ``mm_chunk_s`` is the median
+implied rate of the matmul reference trials.
+
+On the CI surrogate the recovered rates must land within 5% of the
+generating constants (surrogate.CONSTANTS) — the acceptance pin that
+proves sweep -> ledger records -> refit closes the loop.  On device the
+same solve produces the real constants, and ``to_measured_table`` /
+``update_budgets`` persist them in the kernel_bench ``measured`` format
+(tools/kernel_budgets.json) that ``measured_calibration`` and the
+balance prior warm-start from — with the same refusal contract:
+``update_budgets`` will not commit an interpret table as rates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from roc_tpu.tune.surrogate import CONSTANTS
+
+
+def _fields(tr):
+    """Normalize a TrialRecord or a raw ledger measurement dict to the
+    solve's inputs; None when the record lacks the schedule facts."""
+    if isinstance(tr, dict):
+        if tr.get("model") not in ("tune_trial", "tune_confirm",
+                                   "tune_probe") or "steps" not in tr:
+            return None
+        return {"t": float(tr["value"]), "steps": int(tr["steps"]),
+                "dma_units": float(tr.get("dma_units", 0.0)),
+                "flat": bool(tr.get("flat", 0)),
+                "mac_bound": bool(tr.get("mac_bound", False)),
+                "default_knobs": bool(tr.get("default_knobs", True)),
+                "matmul": bool(tr.get("matmul", False)),
+                "stage": str(tr.get("stage", "")),
+                "variant": str(tr.get("variant", "")),
+                "shape": str(tr.get("shape", ""))}
+    return {"t": tr.trial_s, "steps": tr.steps, "dma_units": tr.dma_units,
+            "flat": bool(tr.geom and tr.geom[7]) if len(tr.geom) > 7
+            else False, "mac_bound": tr.mac_bound,
+            "default_knobs": tr.default_knobs,
+            "matmul": tr.stage == "matmul", "stage": tr.stage,
+            "variant": tr.variant, "shape": tr.shape}
+
+
+def refit_rates(trials) -> dict:
+    """Solve the rate constants from trial records (TrialRecords from a
+    live sweep, or ledger measurement dicts from the JSONL stream).
+
+    Returns {chunk_s, slot_dma_s, flat_dma_s, mm_chunk_s, n_agg, n_mm,
+    vs_constants: {name: refit/committed ratio}} — rates are None when
+    no eligible trials identify them (e.g. no flat trials survived the
+    halving: the flat column drops out rather than polluting the fit)."""
+    agg, mm = [], []
+    for tr in trials:
+        f = _fields(tr)
+        if f is None:
+            continue
+        if f["matmul"]:
+            if f["steps"] > 0:
+                mm.append(f["t"] / f["steps"])
+            continue
+        if f["mac_bound"] or not f["default_knobs"] or \
+                "+fuse" in f["variant"]:
+            continue
+        agg.append(f)
+    # The probe stage is search.py's designed experiment; the halving's
+    # own survivors cluster (near-collinear steps vs dma_units), so when
+    # probes exist they ARE the calibration set.
+    probes = [f for f in agg if f["stage"] == "probe"]
+    if probes:
+        agg = probes
+    out = {"chunk_s": None, "slot_dma_s": None, "flat_dma_s": None,
+           "mm_chunk_s": None, "n_agg": len(agg), "n_mm": len(mm)}
+    if agg:
+        cols = [[f["steps"] for f in agg],
+                [0.0 if f["flat"] else f["dma_units"] for f in agg],
+                [f["dma_units"] if f["flat"] else 0.0 for f in agg]]
+        names = ["chunk_s", "slot_dma_s", "flat_dma_s"]
+        # drop all-zero columns (no flat or no non-flat trials) so the
+        # lstsq stays full-rank and deterministic
+        keep = [i for i, c in enumerate(cols) if any(v != 0 for v in c)]
+        A = np.asarray([cols[i] for i in keep], dtype=np.float64).T
+        b = np.asarray([f["t"] for f in agg], dtype=np.float64)
+        # measurement noise is multiplicative (a fraction of each total),
+        # so weight rows by 1/t: otherwise the long trials' absolute
+        # noise drowns the small DMA column's contrast
+        w = 1.0 / np.maximum(b, 1e-12)
+        sol, *_ = np.linalg.lstsq(A * w[:, None], b * w, rcond=None)
+        for i, v in zip(keep, sol):
+            out[names[i]] = float(v)
+    if mm:
+        mm.sort()
+        out["mm_chunk_s"] = mm[len(mm) // 2]
+    committed = {"chunk_s": CONSTANTS["chunk_s"],
+                 "slot_dma_s": CONSTANTS["slot_dma_s"],
+                 "flat_dma_s": CONSTANTS["slot_dma_s"],
+                 "mm_chunk_s": CONSTANTS["mm_chunk_s"]}
+    out["vs_constants"] = {
+        k: out[k] / committed[k]
+        for k in committed if out.get(k) is not None and committed[k]}
+    return out
+
+
+def to_measured_table(trials, interpret: bool, platform: str = "",
+                      h: int = 0) -> dict:
+    """Trial records -> the kernel_bench ``measured`` table shape
+    (binned.measured_calibration's input): per shape, the confirm-stage
+    aggregation rows as per_step_s and the matmul reference as
+    per_chunk_s.  ``interpret`` rides the table so the refusal contract
+    holds end to end — a surrogate table validates schema in CI but is
+    never read back as rates."""
+    shapes: dict = {}
+    for tr in trials:
+        f = _fields(tr)
+        if f is None or f["steps"] <= 0:
+            continue
+        stage = tr.get("stage", "") if isinstance(tr, dict) else tr.stage
+        label = (tr.get("cand", tr.get("label", "")) if isinstance(tr, dict)
+                 else tr.label)
+        kernels = shapes.setdefault(f["shape"] or "swept",
+                                    {"kernels": {}})["kernels"]
+        if f["matmul"]:
+            kernels["matmul"] = {
+                "variant": "matmul", "chunks": f["steps"],
+                "total_s": f["t"], "per_chunk_s": f["t"] / f["steps"]}
+        elif stage == "confirm" and f["default_knobs"] \
+                and not f["mac_bound"]:
+            kernels[f"tuned/{label}"] = {
+                "variant": "flat" if f["flat"] else "twopass",
+                "steps_total": f["steps"], "total_s": f["t"],
+                "per_step_s": f["t"] / f["steps"]}
+    return {"interpret": bool(interpret), "platform": platform, "h": h,
+            "source": "roc_tpu.tune refit", "shapes": shapes}
+
+
+def update_budgets(table: dict, path: str = "") -> str:
+    """Commit a refit table under kernel_budgets.json's ``measured`` key
+    (the kernel_bench --update discipline: everything AROUND the key is
+    preserved).  Refuses interpret tables — CI surrogate timings must
+    never become the rates a device run warm-starts from."""
+    if table.get("interpret", True):
+        raise SystemExit(
+            "tune.refit: refusing to commit an interpret/surrogate table "
+            "as measured rates (measured_calibration contract)")
+    path = path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "..", "tools", "kernel_budgets.json")
+    path = os.path.abspath(path)
+    committed = {}
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            committed = json.load(f)
+    committed["measured"] = table
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(committed, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path

@@ -143,6 +143,13 @@ _POSITIVE = {
         "def f(buf, t):\n"
         "    buf.record_event('probe', predicted_time_s=t)\n",
     ],
+    "hand-rolled-geometry": [
+        "from roc_tpu.ops.pallas.binned import Geometry\n"
+        "g = Geometry(512, 2048, 128, 512, 4096)\n",
+        "import roc_tpu.ops.pallas.binned as B\n"
+        "plan = build(B.Geometry(sb=512, ch=2048, slot=32, rb=512,"
+        " ch2=4096))\n",
+    ],
 }
 
 _CLEAN = [
@@ -166,6 +173,10 @@ _CLEAN = [
     # are plain emit kwargs without the predicted_/measured_ shape
     "row = {'prediction': 0.1, 'measure': 2}\n"
     "def f(reg, t):\n    reg.emit('epoch', step_s=t)\n",
+    # a deliberate grid point rides the waiver (the sweep-harness idiom)
+    "from roc_tpu.ops.pallas.binned import Geometry\n"
+    "# roclint: allow(hand-rolled-geometry)\n"
+    "g = Geometry(512, 2048, 128, 512, 4096)\n",
 ]
 
 

@@ -148,6 +148,17 @@ note "    the CPU table in the repo is schema ballast, never trusted)."
 note "    Review + commit the kernel_budgets.json diff after the window."
 KB_DEVICE=1 KB_REPS=5 timeout 1800 \
     python tools/kernel_bench.py --update 2>&1 | tail -20 | tee -a "$LOG"
+
+note "    ... then the geometry AUTOTUNER (roc_tpu/tune): successive-"
+note "    halving sweep of the kernel-config lattice at the device shapes,"
+note "    winners persisted content-keyed into tuned.json beside the plan"
+note "    cache (choose_geometry consults them before its analytic model"
+note "    on the very next run), the refit stage re-solving chunk_s /"
+note "    slot_dma_s / flat-DMA / mm_chunk_s from the trial records into"
+note "    the kernel_budgets measured table, and the calibration report"
+note "    grading every trial's predict/measure pair.  One command:"
+timeout 3600 python -m roc_tpu.tune --device --shapes device \
+    --refit --update 2>&1 | tail -25 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 4 ]; then

@@ -39,9 +39,17 @@ records ride KB_OBS_DIR/metrics.jsonl (default roc_obs_kb) and feed
     python tools/kernel_bench.py                 # CI shape, interpret
     python tools/kernel_bench.py --update        # + write measured table
     KB_DEVICE=1 python tools/kernel_bench.py --update   # hardware table
+    python tools/kernel_bench.py --filter flat/mega_shard_scaled
+        # bench only the selected rows: each --filter is an fnmatch
+        # pattern against "<variant>/<shape>" (or "<shape>/<variant>",
+        # or a bare variant/shape name); repeat or comma-separate to
+        # select several.  --update still rewrites the whole measured
+        # key, so filtered runs are for iteration, not for the table of
+        # record (docs/DESIGN.md §Autotuner).
 """
 
 import dataclasses
+import fnmatch
 import json
 import os
 import sys
@@ -71,6 +79,18 @@ SHAPES_DEVICE = SHAPES_CI + [
     ("reddit_scaled", 32768, 4_194_304, 0),
     ("products_scaled", 262_144, 2_097_152, 1),
 ]
+
+#: --filter patterns (fnmatch); empty = bench everything.
+FILTERS = []
+
+
+def _want(shape: str, variant: str) -> bool:
+    """Row selection for --filter: a pattern may name the row as
+    variant/shape or shape/variant, or just one side of it."""
+    if not FILTERS:
+        return True
+    keys = (f"{variant}/{shape}", f"{shape}/{variant}", variant, shape)
+    return any(fnmatch.fnmatch(k, p) for p in FILTERS for k in keys)
 
 
 def _geometries():
@@ -168,13 +188,18 @@ def bench_shape(name, n, e, seed, interpret, led):
     entry = {"num_rows": n, "num_edges": e, "seed": seed, "kernels": {}}
 
     for gname, geom in _geometries():
+        if not _want(name, gname):
+            continue
         cb, cn, cnt = B._cell_stats(src, dst, geom.sb, geom.rb)
         _, s1, s2 = B._plan_steps(cb, cn, cnt, geom, n, n, e)
         # geom_time pairing: predict under the ledger with THIS geometry
         # forced, then measure the built plan's wall time by content key.
         _, pred_t = B.choose_geometry(src, dst, n, n, candidates=[geom],
                                       force=True)
-        plan = B.build_binned_plan(src, dst, n, n, geom=geom)
+        # tuned_ok=False: the bench times exactly the geometry it names
+        # (a tuned-tier swap here would silently A/B the wrong config)
+        plan = B.build_binned_plan(src, dst, n, n, geom=geom,
+                                   tuned_ok=False)
         key = B._plan_key(n, n, e, plan.geom)
         row = {"steps_total": int(s1 + s2)}
 
@@ -208,41 +233,51 @@ def bench_shape(name, n, e, seed, interpret, led):
               f"({row['steps_total']} steps, modeled {pred_t * 1e3:.2f} ms)")
 
     # Fused backward over the transposed plan (the plans.bwd direction).
-    bwd_geom = B.GEOM_FLAT_BF16
-    bwd_plan = B.build_binned_plan(dst, src, n, n, geom=bwd_geom)
-    g = jnp.asarray(rng.standard_normal((n, H)).astype(np.float32))
-    y = jnp.abs(x)
-    probe = B.run_binned_linear_bwd(g, y, w, bwd_plan, interpret, relu=True)
-    if probe is not None:
-        tb = _timeit(lambda: jax.jit(
-            lambda gg, yy, ww: B.run_binned_linear_bwd(
-                gg, yy, ww, bwd_plan, interpret, relu=True))(g, y, w))
-        entry["kernels"]["flat_bf16/mega_bwd"] = {
-            "variant": "mega_bwd", "total_s": tb,
-            "steps_total": int(bwd_plan.f_blk.shape[0]),
-            "per_step_s": tb / max(int(bwd_plan.f_blk.shape[0]), 1)}
-        print(f"{name}/flat_bf16 mega_bwd: {tb * 1e3:.2f} ms")
-    else:
-        print(f"{name}/flat_bf16 mega_bwd: gate closed (skipped)")
+    if _want(name, "mega_bwd"):
+        bwd_geom = B.GEOM_FLAT_BF16
+        bwd_plan = B.build_binned_plan(dst, src, n, n, geom=bwd_geom,
+                                       tuned_ok=False)
+        g = jnp.asarray(rng.standard_normal((n, H)).astype(np.float32))
+        y = jnp.abs(x)
+        probe = B.run_binned_linear_bwd(g, y, w, bwd_plan, interpret,
+                                        relu=True)
+        if probe is not None:
+            tb = _timeit(lambda: jax.jit(
+                lambda gg, yy, ww: B.run_binned_linear_bwd(
+                    gg, yy, ww, bwd_plan, interpret, relu=True))(g, y, w))
+            entry["kernels"]["flat_bf16/mega_bwd"] = {
+                "variant": "mega_bwd", "total_s": tb,
+                "steps_total": int(bwd_plan.f_blk.shape[0]),
+                "per_step_s": tb / max(int(bwd_plan.f_blk.shape[0]), 1)}
+            print(f"{name}/flat_bf16 mega_bwd: {tb * 1e3:.2f} ms")
+        else:
+            print(f"{name}/flat_bf16 mega_bwd: gate closed (skipped)")
 
     # The one-hot matmul backend — the rate the balance prior prices.
     # Its chunk planner requires dst-sorted edges (csr order; the binned
     # planners sort internally).
-    order = np.argsort(dst, kind="stable")
-    plans = build_aggregate_plans(src[order], dst[order], n, n)
-    chunks = B._matmul_chunks(e, n)
-    tm = _timeit(lambda: jax.jit(
-        lambda xx: scatter_gather_matmul(xx, plans, n, n))(x))
-    entry["kernels"]["matmul"] = {
-        "variant": "matmul", "chunks": int(chunks), "total_s": tm,
-        "per_chunk_s": tm / max(chunks, 1)}
-    print(f"{name}/matmul: {tm * 1e3:.2f} ms ({chunks} chunks)")
+    if _want(name, "matmul"):
+        order = np.argsort(dst, kind="stable")
+        plans = build_aggregate_plans(src[order], dst[order], n, n)
+        chunks = B._matmul_chunks(e, n)
+        tm = _timeit(lambda: jax.jit(
+            lambda xx: scatter_gather_matmul(xx, plans, n, n))(x))
+        entry["kernels"]["matmul"] = {
+            "variant": "matmul", "chunks": int(chunks), "total_s": tm,
+            "per_chunk_s": tm / max(chunks, 1)}
+        print(f"{name}/matmul: {tm * 1e3:.2f} ms ({chunks} chunks)")
     return entry
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     update = "--update" in argv
+    it = iter(argv)
+    for a in it:
+        if a == "--filter":
+            FILTERS.extend(p for p in next(it, "").split(",") if p)
+        elif a.startswith("--filter="):
+            FILTERS.extend(p for p in a.split("=", 1)[1].split(",") if p)
     import jax
     from roc_tpu import obs
     platform = jax.default_backend()
@@ -266,8 +301,9 @@ def main(argv=None) -> int:
              "reps": REPS, "shapes": {}}
     try:
         for name, n, e, seed in shapes:
-            table["shapes"][name] = bench_shape(name, n, e, seed,
-                                                interpret, led)
+            entry = bench_shape(name, n, e, seed, interpret, led)
+            if entry["kernels"]:        # --filter may deselect a shape
+                table["shapes"][name] = entry
     finally:
         led.detach()
     table["wall_s"] = round(time.time() - t0, 3)

@@ -59,6 +59,16 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python -m roc_tpu.obs calibration --selftest || {
     echo "preflight: calibration selftest RED" >&2; exit 1; }
 
+# Autotune gate: the geometry autotuner's closed CPU world must hold —
+# seeded-surrogate sweep byte-identical across two runs, tuned.json
+# schema valid, choose_geometry provably consumes the tuned entry (and
+# falls back off-key), refit recovers the generating constants within
+# 5%, and every trial pairs in the calibration ledger.
+echo "== autotune selftest =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m roc_tpu.tune --selftest || {
+    echo "preflight: autotune selftest RED" >&2; exit 1; }
+
 # Memory-plan determinism gate: the same config must produce a
 # byte-identical plan JSON (the plan participates in the step cache key —
 # nondeterminism here means phantom retraces and unreproducible OOM
