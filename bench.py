@@ -161,6 +161,17 @@ MEM_PLAN = os.environ.get("ROC_MEM_PLAN", "keep")
 # a different executor.  ROC_STREAM_SLOTS sets the prefetch ring depth.
 STREAM = _env("ROC_BENCH_STREAM", "0", int)
 STREAM_SLOTS = _env("ROC_STREAM_SLOTS", "2", int)
+# ROC_BENCH_SERVE=1: after the training measurement, stand up the serving
+# engine (roc_tpu/serve) on the same graph/model and offer an open-loop
+# query load.  The artifact gains a "serve" block (p50/p99/qps/
+# cold_start_s).  Serving legs annotate the metric and are excluded from
+# vs_baseline and the canonical last-known-good persist: request latency
+# is a different claim than epoch time and must never blend into the
+# training trajectory (tools/serve_bench.py owns the standalone
+# BENCH_SERVE.json artifact; this block is the riding-along capture).
+SERVE = _env("ROC_BENCH_SERVE", "0", int)
+SERVE_REQUESTS = _env("ROC_BENCH_SERVE_REQUESTS", "100", int)
+SERVE_QPS = _env("ROC_BENCH_SERVE_QPS", "50.0", float)
 # ROC_BF16_STORAGE=1 (the same env Config.__post_init__ honors): features
 # stored/staged/exchanged as bf16, fp32 accumulation.  Every artifact is
 # stamped with the storage dtype; bf16 legs annotate the metric and are
@@ -199,7 +210,8 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + ("" if MEM_PLAN == "keep" else f"_mem-{MEM_PLAN}")
           + ("" if DTYPE == "fp32" else f"_{DTYPE}")
           + ("" if FUSION == "none" else f"_{FUSION}")
-          + ("" if not STREAM else f"_stream{STREAM_SLOTS}"))
+          + ("" if not STREAM else f"_stream{STREAM_SLOTS}")
+          + ("" if not SERVE else "_serve"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
 # = ~13 min — long enough to ride out a tunnel hiccup, short enough to
@@ -493,7 +505,8 @@ def run():
         "vs_baseline": round(REF_EPOCH_S / epoch_s, 3)
         if MODEL == "gcn" and CANONICAL_SHAPE and REORDER == "off"
         and BALANCE_EVERY == 0 and MEM_PLAN == "keep"
-        and DTYPE == "fp32" and FUSION == "none" and not STREAM else None,
+        and DTYPE == "fp32" and FUSION == "none" and not STREAM
+        and not SERVE else None,
         "backend": resolved,                   # what auto resolved to
         "dtype": DTYPE,                        # feature-storage dtype
         "fusion": FUSION,                      # layer-fusion level
@@ -593,6 +606,18 @@ def run():
         st = getattr(trainer, "stream_stats", None)
         result["stream"] = st() if callable(st) else {
             "note": "trainer has no stream stats (fell back to in-core)"}
+    if SERVE:
+        # serving leg: same graph/model, the engine's own cold start (the
+        # trainer above already warmed this process's plan cache, so
+        # plan_builds pins the zero-rebuild contract on real shapes too)
+        from roc_tpu.serve import ServeEngine, run_load
+        with ServeEngine(trainer.config, ds, trainer.model) as eng:
+            eng.warmup()
+            load = run_load(eng, n_requests=SERVE_REQUESTS, qps=SERVE_QPS)
+            result["serve"] = dict(
+                load, cold_start_s=eng.cold_start_stats["cold_start_s"],
+                plan_builds=eng.cold_start_stats["plan_builds"],
+                buckets=eng.cold_start_stats["buckets"])
     reg = getattr(trainer, "_metrics", None)
     if reg is not None:
         # -obs / ROC_OBS=1 run: stamp the unified metrics block (the
@@ -632,7 +657,8 @@ def run():
             and CANONICAL_SHAPE and REORDER == "off" and BALANCE_EVERY == 0
             and MEM_PLAN == "keep" and "binned_flat" not in result
             and DTYPE == "fp32" and FUSION == "none" and not STREAM
-            and fallback_from is None and resolved == "binned"):
+            and not SERVE and fallback_from is None
+            and resolved == "binned"):
         try:   # canonical hardware run: persist as the last-known-good
             stamped = dict(result, measured_at=time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))

@@ -24,6 +24,7 @@ from roc_tpu.analysis.hlo_audit import (  # noqa: F401
     audit_against_budgets,
     audit_hlo_text,
     audit_lowered,
+    audit_spec,
     audit_specs,
     audit_trainer,
     build_audit_trainer,

@@ -271,11 +271,17 @@ class AuditSpec:
     parts: int
     backend: str     # configured -aggr-backend
     exchange: str    # halo | allgather | ring | single
+    serve: bool = False  # audit the serving engine's bucketed query step
+                         # instead of the trainer's train/eval steps
 
 
 def audit_specs() -> List[AuditSpec]:
     """model × parts × backend × exchange matrix (ring rides matmul —
-    spmd forces it; parts=1 has no exchange)."""
+    spmd forces it; parts=1 has no exchange), plus serve rows: the
+    serving engine's jitted query step at the smallest and largest
+    padded buckets, so a compiled-program change on the serving path
+    (an extra collective, a dtype widening, a gather blowup) diffs in
+    budgets.json exactly like a training-step change would."""
     specs: List[AuditSpec] = []
     for model in ("gcn", "gat"):
         for backend in ("matmul", "binned"):
@@ -285,6 +291,8 @@ def audit_specs() -> List[AuditSpec]:
                 for exch in ("halo", "allgather"):
                     specs.append(AuditSpec(model, parts, backend, exch))
             specs.append(AuditSpec(model, parts, "matmul", "ring"))
+        for backend in ("matmul", "binned"):
+            specs.append(AuditSpec(model, 1, backend, "serve", serve=True))
     return specs
 
 
@@ -315,6 +323,52 @@ def build_audit_trainer(spec: AuditSpec, *, exchange: Optional[str] = None):
     return make_trainer(cfg, ds, model)
 
 
+def build_audit_engine(spec: AuditSpec):
+    """Cold-start (queueless) the serving engine for one serve row."""
+    import roc_tpu  # noqa: F401 — installs the jax.shard_map polyfill
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_model
+    from roc_tpu.serve.engine import ServeEngine
+    from roc_tpu.train.config import Config
+    ds = datasets.synthetic("roc-audit", **AUDIT_DATASET)
+    cfg = Config(dataset="roc-audit", layers=list(AUDIT_LAYERS),
+                 num_epochs=1, model=spec.model, heads=2,
+                 aggregate_backend=spec.backend, edge_shard="off",
+                 eval_every=10 ** 6, seed=3, serve_batch=8)
+    model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
+                        heads=cfg.heads)
+    return ServeEngine(cfg, ds, model, start_queue=False)
+
+
+def audit_serve_engine(spec: AuditSpec,
+                       key: Optional[str] = None) -> AuditReport:
+    """Lower the engine's serve_step at the bucket ladder's ends: the
+    two programs bound the padded-shape set (middle buckets only vary
+    the gather width between them)."""
+    import jax.numpy as jnp
+    import numpy as np
+    eng = build_audit_engine(spec)
+    try:
+        lowereds = {}
+        for b in (eng.buckets[0], eng.buckets[-1]):
+            lowereds[f"serve_b{b}"] = eng._serve_step.lower(
+                eng.bundle.params, eng.bundle.x, eng.bundle.gdata,
+                jnp.int32(b), jnp.asarray(np.zeros(b, np.int32)))
+        return AuditReport(key=key or spec_key(spec),
+                           steps={n: audit_lowered(lo)
+                                  for n, lo in lowereds.items()},
+                           lowereds=lowereds)
+    finally:
+        eng.close()
+
+
+def audit_spec(spec: AuditSpec, key: Optional[str] = None) -> AuditReport:
+    """One matrix entry → report (trainer steps or serve buckets)."""
+    if spec.serve:
+        return audit_serve_engine(spec, key=key)
+    return audit_trainer(build_audit_trainer(spec), key=key)
+
+
 def run_audit(specs: Optional[List[AuditSpec]] = None,
               progress=None) -> Dict[str, dict]:
     """Lower + audit every matrix entry → {budget key: steps dict}."""
@@ -323,8 +377,7 @@ def run_audit(specs: Optional[List[AuditSpec]] = None,
         key = spec_key(spec)
         if progress:
             progress(key)
-        report = audit_trainer(build_audit_trainer(spec), key=key)
-        out[key] = report.to_json()
+        out[key] = audit_spec(spec, key=key).to_json()
     return out
 
 
@@ -356,7 +409,7 @@ def audit_against_budgets(specs: Optional[List[AuditSpec]] = None,
         key = spec_key(spec)
         if progress:
             progress(key)
-        report = audit_trainer(build_audit_trainer(spec), key=key)
+        report = audit_spec(spec, key=key)
         if key not in budgets:
             viol.append(f"{key}: not in budget manifest (run "
                         f"--update-budgets)")

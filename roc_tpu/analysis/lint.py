@@ -126,6 +126,16 @@ _REMAT_EXEMPT_SUFFIX = os.path.join("roc_tpu", "memory", "policy.py")
 # (everything else times through `obs.span` so measurements reach the
 # exported trace).
 _RAW_TIMING_EXEMPT_DIR = os.path.join("roc_tpu", "obs") + os.sep
+# Serving hot path (roc_tpu/serve/): the microbatch contract is ONE
+# device->host sync per drained window, so ANY sync-shaped call there is
+# a finding unless it carries a documented waiver — the jit-scope rule
+# can't see these (the serving queue/engine host code isn't jit-traced,
+# but a per-request .item() or np.asarray() inside the window still
+# serializes the batch it was built to amortize).
+_SERVE_DIR = os.path.join("roc_tpu", "serve") + os.sep
+_SERVE_SYNC_CALLS = _HOST_SYNC_FNS | {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+}
 # Field names that smell like an out-of-ledger prediction/measurement
 # (the unledgered-prediction rule); the ledger itself (roc_tpu/obs/)
 # is exempt — it *is* the sanctioned sink for these.
@@ -264,7 +274,28 @@ class _FileLint:
         self._rule_remat()
         self._rule_unledgered_prediction()
         self._rule_hand_rolled_geometry()
+        self._rule_serve_sync()
         return self.findings
+
+    def _rule_serve_sync(self):
+        """Sync-shaped calls in roc_tpu/serve/ (see _SERVE_DIR note)."""
+        if _SERVE_DIR not in self.path.replace("/", os.sep):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            head = _dotted(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _TIMED_SYNC_ATTRS:
+                self._flag(node, "host-sync",
+                           f".{node.func.attr}() on the serving path "
+                           f"forces a device->host sync; the microbatch "
+                           f"window sanctions exactly one (waiver it)")
+            elif head in _SERVE_SYNC_CALLS:
+                self._flag(node, "host-sync",
+                           f"{head}() on the serving path is a potential "
+                           f"device->host sync; one per drained window is "
+                           f"the contract (waiver the sanctioned site)")
 
     def _rule_jit_scope(self, roots: Set[int]):
         for node in ast.walk(self.tree):

@@ -104,6 +104,41 @@ def model_flops_bytes(model, num_nodes: int, num_edges: int,
     return flops, nbytes
 
 
+def forward_flops_bytes(model, num_nodes: int, num_edges: int,
+                        precision: str = "fast"):
+    """(FLOPs, min HBM bytes) for ONE inference forward — the serving
+    window's cost.  Same IR walk and convention as ``model_flops_bytes``
+    with the backward shares removed: a linear is one 2·N·Fin·Fout pass
+    over one byte-sweep (training's 6/3 is fwd + two bwd), an aggregate
+    is one 2·E·F pass over one edge-stream sweep (training's 4/2).  The
+    serving ledger pair (serve/engine.py) predicts window p50 from this
+    bound; `python -m roc_tpu.obs calibration` then reports how far the
+    measured serving path sits above it."""
+    N, E = float(num_nodes), float(num_edges)
+    b = itemsize_for(precision)
+    dims = {model.input.id: model.input.dim}
+    flops = nbytes = 0.0
+    for op in model.ops:
+        a = dims[op.inputs[0]]
+        if op.kind == "linear":
+            out = int(op.attrs["out_dim"])
+            flops += 2.0 * N * a * out
+            nbytes += N * a * b + N * out * b
+        elif op.kind == "gat":
+            out = int(op.attrs["heads"]) * int(op.attrs["head_dim"])
+            flops += 2.0 * N * a * out + 2.0 * E * out
+            nbytes += N * a * b + N * out * b
+            nbytes += E * out * b + N * out * b + E * 4
+        elif op.kind == "aggregate":
+            out = a
+            flops += 2.0 * E * out
+            nbytes += E * out * b + N * out * b + E * 4
+        else:
+            out = a          # elementwise: O(N*F) noise, not counted
+        dims[op.out] = out
+    return flops, nbytes
+
+
 def roofline_time(flops: float, nbytes: float, n_dev: int = 1,
                   peak_flops: float = None, peak_bw: float = None) -> float:
     """Best-possible epoch seconds: max of the compute- and memory-bound

@@ -61,6 +61,9 @@ class PerfWatchdog:
         # host->device prefetch; stream executor runs only)
         self.stall_ewma: Optional[float] = None
         self.stall_observed = 0
+        # serving p99-latency EWMA (serve engine runs only)
+        self.serve_ewma: Optional[float] = None
+        self.serve_observed = 0
         # per-cost-model measured/predicted ratio EWMAs (ledger feed)
         self.calibration_band = (float(calibration_band[0]),
                                  float(calibration_band[1]))
@@ -117,6 +120,30 @@ class PerfWatchdog:
         self.stall_observed += 1
         return alert
 
+    def observe_serve(self, window: int, p99_s: float) -> Optional[dict]:
+        """Feed one serving p99 sample (the engine aggregates a few
+        windows of per-request latencies before each feed —
+        serve/engine.py _note_window).  Alert when the p99 exceeds
+        ``ratio`` x its own EWMA: queueing collapse or a slow device
+        dispatch shows up in the tail long before the mean moves.
+        Observation 0 carries warmup-trace and first-touch noise and
+        never sets the baseline, mirroring observe_stream."""
+        p99 = float(p99_s)
+        armed = self.serve_ewma is not None and \
+            self.serve_observed >= self.warmup
+        alert = None
+        if armed and p99 > self.ratio * self.serve_ewma:
+            alert = {"kind": "serve-latency", "window": int(window),
+                     "p99_s": p99, "ewma_s": float(self.serve_ewma),
+                     "ratio": p99 / self.serve_ewma}
+            self.alerts.append(alert)
+            p99 = self.ratio * self.serve_ewma  # clamp, as observe_epoch
+        if self.serve_observed >= 1:
+            self.serve_ewma = p99 if self.serve_ewma is None else \
+                self.alpha * p99 + (1.0 - self.alpha) * self.serve_ewma
+        self.serve_observed += 1
+        return alert
+
     def observe_shards(self, epoch: int, times_s) -> List[dict]:
         """Feed per-shard probe times (balance/manager.py's samples);
         returns straggler alerts (possibly empty)."""
@@ -164,8 +191,8 @@ class PerfWatchdog:
 
     def verdict(self) -> str:
         """"regressed" if any slow-epoch fired, then "straggler", then
-        "stream-stall", then "calibration-drift", "ok" otherwise —
-        stamped into bench artifacts."""
+        "stream-stall", then "serve-latency", then "calibration-drift",
+        "ok" otherwise — stamped into bench artifacts."""
         kinds = {a["kind"] for a in self.alerts}
         if "slow-epoch" in kinds:
             return "regressed"
@@ -173,6 +200,8 @@ class PerfWatchdog:
             return "straggler"
         if "stream-stall" in kinds:
             return "stream-stall"
+        if "serve-latency" in kinds:
+            return "serve-latency"
         if "calibration-drift" in kinds:
             return "calibration-drift"
         return "ok"

@@ -1131,6 +1131,19 @@ def _prefix_within_runs(values: np.ndarray, keys: np.ndarray) -> np.ndarray:
     return csum - np.repeat(csum[first], run_len)
 
 
+# Process-wide count of ACTUAL plan builds (cache hits don't count).
+# The serve cold-start contract ("cache load + one trace, zero plan
+# rebuilds", roc_tpu/serve) snapshots this before/after engine
+# construction — a counter is pinnable where a span name is not.
+_PLAN_BUILD_COUNT = 0
+
+
+def plan_build_count() -> int:
+    """How many binned plans this process built from scratch (cache
+    hits excluded).  Monotone; diff across a window to pin rebuilds."""
+    return _PLAN_BUILD_COUNT
+
+
 def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
                       num_rows: int, table_rows: int,
                       group_row_target: int = _GROUP_ROW_TARGET,
@@ -1178,6 +1191,8 @@ def build_binned_plan(edge_src: np.ndarray, edge_dst: np.ndarray,
         if plan is not None:
             _ledger_note_plan(plan, len(edge_src))
             return plan
+    global _PLAN_BUILD_COUNT
+    _PLAN_BUILD_COUNT += 1
     if len(edge_src) >= (1 << 20) and native.available():
         if geom.flat:
             (p1_srcl, p1_blk, p1_blk2, p1_dsrc, p1_ddst, p2_dstl, p2_obi,

@@ -1,0 +1,23 @@
+"""Inference serving path: microbatched node queries over the frozen
+plan cache (ISSUE "serving" tentpole; design in docs/DESIGN.md §Serving).
+
+  queue.py    MicrobatchQueue + ServeFuture — accumulate requests into
+              windows (-serve-batch / -serve-wait-ms)
+  engine.py   ServeEngine — frozen params in device buffers, bucketed
+              jitted serve_step over the training forward, cold start =
+              plan-cache load + one trace (zero rebuilds, pinned)
+  parity.py   max_ulp_diff — the ≤32-ULP served-vs-eval gate
+  loadgen.py  open-loop QPS generator for benches and the smoke gate
+
+`python -m roc_tpu.serve --selftest` is the CPU end-to-end smoke:
+cold start from a warm plan cache, ~100 mixed-size queries, parity +
+zero-retrace asserted (wired into tools/preflight.sh).
+"""
+
+from roc_tpu.serve.engine import ServeEngine, bucket_sizes
+from roc_tpu.serve.loadgen import run_load
+from roc_tpu.serve.parity import max_ulp_diff
+from roc_tpu.serve.queue import MicrobatchQueue, ServeFuture
+
+__all__ = ["ServeEngine", "MicrobatchQueue", "ServeFuture", "bucket_sizes",
+           "max_ulp_diff", "run_load"]

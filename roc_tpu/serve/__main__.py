@@ -1,0 +1,96 @@
+"""`python -m roc_tpu.serve --selftest`: the serving smoke gate.
+
+End-to-end on CPU with the tiny audit graph (preflight's serve-smoke
+step): train a couple of epochs to warm the content-keyed plan cache and
+write a checkpoint, then cold-start a ServeEngine from that warm cache
+and assert the three serving contracts in one process:
+
+  1. cold start performs ZERO plan rebuilds (plan_build_count diff),
+  2. served logits match the eval forward to <= 32 ULPs,
+  3. a ~100-request mixed-batch-size stream retraces NOTHING after
+     warmup (RetraceGuard baseline diff).
+
+Exit 0 with a one-line summary per contract; any violation raises.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+
+def selftest() -> int:
+    tmp = tempfile.mkdtemp(prefix="roc_serve_selftest_")
+    # engage the plan cache on the tiny graph: content-keyed dir in tmp,
+    # no min-edge floor (the audit graph is far below the default 1<<24)
+    os.environ["ROC_PLAN_CACHE_DIR"] = os.path.join(tmp, "plan_cache")
+    os.environ["ROC_PLAN_CACHE_MIN_EDGES"] = "0"
+
+    import numpy as np
+
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_model
+    from roc_tpu.serve import ServeEngine, max_ulp_diff, run_load
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import make_trainer
+
+    cfg = Config(dataset="roc-audit", layers=[8, 16, 4], num_epochs=2,
+                 aggregate_backend="binned", serve_batch=8,
+                 serve_wait_ms=1.0)
+    ds = datasets.get(cfg.dataset, seed=cfg.seed)
+    model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
+                        heads=cfg.heads)
+
+    # -- warm: a short training run builds + persists this graph's plans
+    trainer = make_trainer(cfg, ds, model)
+    trainer.train()
+    ckpt = os.path.join(tmp, "serve.ckpt.npz")
+    from roc_tpu.train import checkpoint
+    checkpoint.save(ckpt, trainer.params, trainer.opt_state, trainer.epoch,
+                    trainer.optimizer.alpha)
+    # the parity oracle is fetched once, before serving starts
+    oracle = np.asarray(trainer.predict_logits())  # roclint: allow(host-sync)
+    del trainer
+
+    # -- cold start from the warm cache
+    with ServeEngine(cfg, ds, model, checkpoint_path=ckpt) as eng:
+        cs = eng.cold_start_stats
+        assert cs["plan_builds"] == 0, (
+            f"cold start rebuilt {cs['plan_builds']} plan(s); the warm "
+            f"plan cache must make cold start a cache read")
+        print(f"# serve selftest: cold start {cs['cold_start_s']:.3f}s, "
+              f"plan_builds=0, traces={cs['traces']}, "
+              f"buckets={cs['buckets']}")
+
+        # -- parity: served rows vs the trainer's eval logits
+        ids = np.arange(ds.graph.num_nodes, dtype=np.int32)
+        served = eng.query(ids, timeout=120.0)
+        ulps = max_ulp_diff(served, oracle[ids])
+        assert ulps <= 32, f"served vs eval parity: {ulps} ULPs > 32"
+        print(f"# serve selftest: parity vs eval forward = {ulps} ULPs "
+              f"(gate: <=32)")
+
+        # -- zero retraces across a mixed-size request stream
+        eng.warmup()
+        baseline = eng._guard.snapshot()
+        stats = run_load(eng, n_requests=100, qps=2000.0,
+                         sizes=(1, 2, 3, 5, 8, 13))
+        eng._guard.assert_no_new_traces(baseline)
+        print(f"# serve selftest: 100-request stream, zero retraces; "
+              f"p50={stats['p50_s'] * 1e3:.2f}ms "
+              f"p99={stats['p99_s'] * 1e3:.2f}ms "
+              f"({stats['qps_achieved']:.0f} qps achieved)")
+    print("# serve selftest: OK")
+    return 0
+
+
+def main(argv) -> int:
+    if "--selftest" in argv:
+        return selftest()
+    print("usage: python -m roc_tpu.serve --selftest", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

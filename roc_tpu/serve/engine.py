@@ -1,0 +1,265 @@
+"""ServeEngine: frozen-params node-query serving over the plan cache.
+
+The serving bet (ROADMAP "inference serving path"): the training hot
+path IS the serving hot path.  The engine loads a checkpoint through
+`train.frozen.load_frozen` (weights only, no optimizer arrays), builds
+graph data through the SAME backend resolution as training, pulls binned
+plans from the content-keyed disk cache — a warm cache means cold start
+is a cache load plus ONE jit trace and ZERO plan rebuilds (pinned:
+`cold_start_stats["plan_builds"]` diffs the builder's process counter) —
+and then answers node-level queries by running the existing
+binned/megakernel forward exactly as eval does, gathering the queried
+rows in-graph.  No kernel changes; that is the point.
+
+Shape discipline: query batches are bucketed to a power-of-two ladder
+capped at ``-serve-batch`` and padded to the bucket, so an arbitrary
+request stream compiles at most ``len(buckets)`` serve_step variants and
+the RetraceGuard can assert zero retraces after `warmup()`
+(tests/test_serve.py pins a 100-request mixed-size stream).  Params stay
+device-resident for the engine's lifetime; the per-call query-index
+buffer is donated to the step on TPU (it is consumed once per dispatch).
+
+Graphs that don't fit in-core serve through the streaming executor's
+slot machinery (`config.stream`): each drained window sweeps the
+host-resident shards through the frozen padded device slots — the same
+rotation eval uses — and gathers the queried rows on the host.
+
+Dynamic-graph deltas are the follow-on, NOT implemented here: see
+`apply_delta` for the design note.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from roc_tpu import obs
+from roc_tpu.analysis import retrace as _retrace
+from roc_tpu.graph.datasets import Dataset
+from roc_tpu.models.model import Model
+from roc_tpu.serve.queue import MicrobatchQueue, ServeFuture
+from roc_tpu.train.config import Config
+from roc_tpu.train.frozen import FrozenBundle, load_frozen
+
+# Feed the watchdog's serve-latency EWMA once per this many windows —
+# p99 over a single window of a few requests is noise, not a tail.
+_P99_FEED_WINDOWS = 8
+
+
+def bucket_sizes(batch: int):
+    """The padded-shape ladder: powers of two up to ``batch`` (inclusive,
+    ``batch`` itself always last even when not a power of two)."""
+    out, b = [], 1
+    while b < batch:
+        out.append(b)
+        b *= 2
+    out.append(int(batch))
+    return out
+
+
+class ServeEngine:
+    """Microbatched node-query engine over frozen params + plan cache."""
+
+    def __init__(self, config: Config, dataset: Dataset, model: Model,
+                 checkpoint_path: Optional[str] = None,
+                 watchdog=None, start_queue: bool = True):
+        from roc_tpu.ops.pallas import binned as _B
+        self.config = config
+        self.dataset = dataset
+        self.model = model
+        self.watchdog = watchdog
+        self.buckets = bucket_sizes(config.serve_batch)
+        self._lat_buf: list = []
+        self._p99_windows = 0
+        # The engine's own trace counter: note_trace("serve_step") fires
+        # only while jax is tracing, so the guard's counts ARE the trace
+        # count.  Never self-arms (tests arm their own); close() exits it.
+        self._guard = _retrace.RetraceGuard(warmup=1 << 30,
+                                            on_violation="record")
+        self._guard.__enter__()
+        builds0 = _B.plan_build_count()
+        with obs.span("serve_cold_start") as sp:
+            self.bundle: FrozenBundle = load_frozen(
+                config, dataset, model, checkpoint_path)
+            self._build_serve_step()
+            # one trace on the smallest bucket proves the program compiles
+            # before the first request lands; warmup() traces the rest
+            if self.bundle.stream_trainer is None:
+                self._serve_rows(np.zeros(1, np.int32))
+        self.cold_start_stats = {
+            "cold_start_s": round(sp.dur_s, 6),
+            "plan_builds": _B.plan_build_count() - builds0,
+            "traces": int(sum(self._guard.counts.values())),
+            "buckets": list(self.buckets),
+        }
+        # Ledger pair: serving p50 predicted from the forward-only
+        # roofline bound (one full-graph forward per window — the query
+        # gather rides it for free), measured from observed request p50
+        # at each watchdog feed.  `python -m roc_tpu.obs calibration`
+        # then covers serving next to the training-side models.
+        g = dataset.graph
+        fl, nb = obs.roofline.forward_flops_bytes(
+            model, g.num_nodes, g.num_edges, config.aggregate_precision)
+        self._roofline_p50_s = obs.roofline.roofline_time(fl, nb)
+        self._ledger_key = obs.ledger.content_key(
+            model=config.model, nodes=g.num_nodes, edges=g.num_edges,
+            precision=config.aggregate_precision, batch=config.serve_batch)
+        obs.get_ledger().predict("serve-p50", self._ledger_key,
+                                 self._roofline_p50_s, "s")
+        self.queue = None
+        if start_queue:
+            self.queue = MicrobatchQueue(
+                self._serve_rows, batch=config.serve_batch,
+                wait_ms=config.serve_wait_ms, on_window=self._note_window)
+
+    # -- the jitted query step --------------------------------------------
+    def _build_serve_step(self):
+        if self.bundle.stream_trainer is not None:
+            self._serve_step = None
+            return
+        from roc_tpu.train.driver import make_gctx
+        model = self.model
+        n, mega = self.bundle.num_nodes, self.bundle.megafuse
+        # qidx is consumed once per dispatch — donate it where donation
+        # is implemented (TPU); on CPU the hint would only warn.
+        donate = (4,) if jax.default_backend() in obs.roofline.TPU_BACKENDS \
+            else ()
+
+        @partial(jax.jit, donate_argnums=donate)
+        def serve_step(params, x, gdata, valid, qidx):
+            _retrace.note_trace("serve_step")
+            logits = model.apply(params, x, make_gctx(gdata, n, mega),
+                                 train=False)
+            del valid  # padding rows are sliced off after the sync
+            return jnp.take(logits, qidx, axis=0)
+
+        self._serve_step = serve_step
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _serve_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Serve one drained window: [k] node ids -> [k, C] logits.
+        Chunks larger than the top bucket split across dispatches; each
+        dispatch pays exactly one device round trip."""
+        ids = ids.reshape(-1)
+        if ids.size == 0:
+            return np.zeros((0, self.dataset.num_classes), np.float32)
+        nn = self.bundle.num_nodes
+        if ids.min() < 0 or ids.max() >= nn:
+            raise IndexError(f"query ids must be in [0, {nn})")
+        with obs.span("serve_window", n=int(ids.size)) as sp:
+            if self.bundle.stream_trainer is not None:
+                # out-of-core: one slot sweep per window, gather on host.
+                # This is the window's ONE sanctioned batch-boundary sync.
+                logits = self.bundle.predict_logits()
+                out = np.asarray(logits)[ids]  # roclint: allow(host-sync)
+            else:
+                parts = []
+                cap = self.buckets[-1]
+                for lo in range(0, ids.size, cap):
+                    chunk = ids[lo:lo + cap]
+                    b = self.bucket_for(chunk.size)
+                    qidx = np.zeros(b, np.int32)
+                    qidx[:chunk.size] = chunk
+                    res = self._serve_step(
+                        self.bundle.params, self.bundle.x,
+                        self.bundle.gdata, jnp.int32(chunk.size),
+                        jnp.asarray(qidx))
+                    # the window's ONE sanctioned batch-boundary sync:
+                    # exactly one result fetch per dispatched chunk
+                    res = np.asarray(res)  # roclint: allow(host-sync)
+                    parts.append(res[:chunk.size])
+                out = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        del sp
+        return out
+
+    # -- request API ------------------------------------------------------
+    def submit(self, node_ids: Sequence[int]) -> ServeFuture:
+        assert self.queue is not None, "engine built with start_queue=False"
+        return self.queue.submit(node_ids)
+
+    def query(self, node_ids: Sequence[int], timeout: float = 60.0):
+        assert self.queue is not None, "engine built with start_queue=False"
+        return self.queue.query(node_ids, timeout)
+
+    def warmup(self):
+        """Trace every bucket now, so the first real request stream can
+        assert zero retraces (RetraceGuard) from its very first window."""
+        if self.bundle.stream_trainer is not None:
+            self.bundle.predict_logits()
+            return
+        for b in self.buckets:
+            self._serve_rows(np.zeros(b, np.int32))
+
+    # -- observability ----------------------------------------------------
+    def _note_window(self, latencies):
+        self._lat_buf.extend(latencies)
+        self._p99_windows += 1
+        if self._p99_windows < _P99_FEED_WINDOWS:
+            return
+        lats = sorted(self._lat_buf)
+        p99 = lats[min(int(0.99 * (len(lats) - 1)), len(lats) - 1)]
+        self._p99_windows = 0
+        del self._lat_buf[:]
+        led = obs.get_ledger()
+        led.predict("serve-p50", self._ledger_key, self._roofline_p50_s, "s")
+        led.measure("serve-p50", self._ledger_key, lats[len(lats) // 2], "s")
+        if self.watchdog is None:
+            return
+        alert = self.watchdog.observe_serve(self.queue.windows, p99)
+        if alert is not None and self.config.verbose:
+            print(f"# watchdog: serve p99 {alert['p99_s'] * 1e3:.2f} ms is "
+                  f"{alert['ratio']:.2f}x its EWMA "
+                  f"({alert['ewma_s'] * 1e3:.2f} ms)")
+
+    def stats(self) -> dict:
+        q = self.queue
+        return {
+            "cold_start": dict(self.cold_start_stats),
+            "windows": q.windows if q else 0,
+            "requests": q.served if q else 0,
+            "traces": int(sum(self._guard.counts.values())),
+        }
+
+    # -- lifecycle --------------------------------------------------------
+    def apply_delta(self, add_edges=None, retire_edges=None):
+        """Dynamic-graph deltas — the follow-on, NOT implemented.
+
+        Design note (ROADMAP "dynamic-graph deltas"): appending/retiring
+        edges between requests must NOT replan or retrace.  The intended
+        mechanism reuses the balancer's frozen-shape reshard machinery:
+        a delta re-cuts only the affected binned cells (the plan's
+        (block, bin) groups are content-addressed, so an edge append
+        touches exactly the cells whose source block or dest bin it
+        lands in), patches those cells' slot/offset arrays host-side,
+        and device_put's the patched arrays into the SAME padded buffers
+        — same shapes, same jit cache, no plan-cache miss.  Retired
+        edges mask in place (the kernels already honor slot padding).
+        What is missing is the incremental cell re-cut (today's builders
+        are whole-graph) and a delta journal so a restart replays to the
+        served state; both land with the dynamic-graph PR.
+        """
+        raise NotImplementedError(
+            "dynamic-graph deltas are a designed follow-on (see docstring "
+            "+ docs/DESIGN.md §Serving); the serving engine is static-graph "
+            "for now")
+
+    def close(self):
+        if self.queue is not None:
+            self.queue.close()
+        self._guard.__exit__(None, None, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,66 @@
+"""Open-loop load generator for the serving engine.
+
+Offers requests at a target QPS on a fixed schedule regardless of how
+fast responses come back (open-loop), because closed-loop generators
+hide queueing collapse: a closed loop slows its own offer rate exactly
+when the engine falls behind, so the measured p99 stays flat while real
+clients would be timing out.  Tail latency claims (tools/serve_bench.py,
+PERF.md serving table) are only honest under open-loop offered load.
+
+Request sizes cycle through a caller-supplied mix so a run exercises
+every padded bucket — the same stream shape the zero-retrace test pins.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending-sorted sequence."""
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return float(sorted_vals[i])
+
+
+def run_load(engine, n_requests: int, qps: float,
+             sizes: Sequence[int] = (1, 3, 8),
+             rng: Optional[np.random.Generator] = None,
+             timeout: float = 120.0) -> dict:
+    """Offer ``n_requests`` at ``qps`` (open loop); return latency stats.
+
+    Each request queries ``sizes[i % len(sizes)]`` random node ids.  All
+    futures are collected first and resolved after the offer schedule
+    completes, so a slow window never stalls the offered load.
+    """
+    assert n_requests >= 1 and qps > 0
+    rng = rng or np.random.default_rng(0)
+    nn = engine.bundle.num_nodes
+    futures = []
+    # Open-loop schedule anchor: each request fires at t0 + i/qps on the
+    # host clock.  obs spans time device work, not an offer schedule (and
+    # the submit side must never sync), hence the documented waiver.
+    t0 = time.perf_counter()  # roclint: allow(raw-timing)
+    for i in range(n_requests):
+        target = t0 + i / qps
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        k = int(sizes[i % len(sizes)])
+        futures.append(engine.submit(rng.integers(0, nn, size=k)))
+    for f in futures:
+        f.result(timeout)
+    wall = time.perf_counter() - t0
+    lats: List[float] = sorted(f.latency_s for f in futures)
+    return {
+        "n": n_requests,
+        "qps_offered": round(qps, 3),
+        "qps_achieved": round(n_requests / max(wall, 1e-9), 3),
+        "p50_s": round(percentile(lats, 0.50), 6),
+        "p99_s": round(percentile(lats, 0.99), 6),
+        "mean_s": round(float(np.mean(lats)), 6),
+    }

@@ -1,0 +1,45 @@
+"""ULP-distance parity check between served and eval logits.
+
+The serving parity gate (tests/test_serve.py) is "≤ 32 ULPs", not an
+atol/rtol pair: served and eval forwards run the SAME jitted program on
+the SAME inputs, so any divergence is reduction-order jitter from the
+query gather's fusion decisions — a few ULPs at most — and an absolute
+tolerance would either mask real divergence on small logits or
+false-positive on large ones.  ULP distance is scale-free: reinterpret
+the float bits as lexicographically ordered integers and diff.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _lex_int(x: np.ndarray) -> np.ndarray:
+    """Map float32 bit patterns to integers ordered like the floats:
+    adjacent representable floats differ by exactly 1.  Negative floats
+    (sign bit set) reflect around zero so -0.0 and +0.0 coincide."""
+    b = np.ascontiguousarray(x, np.float32).view(np.int32).astype(np.int64)
+    return np.where(b < 0, np.int64(-(2 ** 31)) - b, b)
+
+
+def max_ulp_diff(a, b) -> int:
+    """Largest elementwise ULP distance between two float arrays.
+
+    Inputs cast to float32 first (bf16 storage still accumulates and
+    emits fp32 logits, so fp32 is the comparison precision everywhere).
+    NaNs must match positionally; any unmatched NaN is reported as the
+    maximum distance rather than poisoning the integer math.
+    """
+    # The parity gate runs off the request path (tests / selftest only),
+    # so pulling both operands to the host is its job, not a leak.
+    a = np.asarray(a, np.float32)  # roclint: allow(host-sync)
+    b = np.asarray(b, np.float32)  # roclint: allow(host-sync)
+    assert a.shape == b.shape, f"shape mismatch: {a.shape} vs {b.shape}"
+    nan_a, nan_b = np.isnan(a), np.isnan(b)
+    if (nan_a != nan_b).any():
+        return int(np.iinfo(np.int64).max)
+    ok = ~nan_a
+    if not ok.any():
+        return 0
+    d = np.abs(_lex_int(a[ok]) - _lex_int(b[ok]))
+    return int(d.max()) if d.size else 0

@@ -1,0 +1,173 @@
+"""Microbatched request queue: accumulate node queries into one window.
+
+Requests arrive one at a time (a node id list each); serving them
+individually would pay one device dispatch + one host sync per request.
+The queue batches instead: a window opens when the first request lands
+and drains when EITHER the accumulated query count reaches
+``-serve-batch`` OR ``-serve-wait-ms`` elapses since the window opened —
+the classic latency/throughput knob pair.  The worker thread hands the
+window's concatenated ids to the engine's serve function in one call, so
+the batched window contains exactly ONE device round trip regardless of
+how many requests rode it (roclint's serve host-sync rule enforces this
+shape: per-request syncs inside the window are findings).
+
+Latency accounting: futures are stamped at submit and completion on the
+host monotonic clock rather than through ``obs.span`` — a span's
+enter/exit pair must nest on one thread's stack, and a request's life
+crosses from the caller's thread to the worker's.  The span tracer still
+owns the device-facing measurement (the engine wraps each drained window
+in ``obs.span("serve_window")``); these stamps only price the queueing
+delay on top of it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+
+class ServeFuture:
+    """One request's pending result (numpy [k, C] logits)."""
+
+    __slots__ = ("ids", "_event", "_value", "_error", "t_submit", "t_done")
+
+    def __init__(self, ids):
+        self.ids = ids
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        # submit/done stamps cross threads; see module docstring for why
+        # these are raw clock reads and not an obs.span
+        self.t_submit = time.perf_counter()
+        self.t_done = 0.0
+
+    def _resolve(self, value=None, error=None):
+        self._value, self._error = value, error
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request not completed in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_s(self) -> float:
+        """Submit-to-completion wall seconds (queue wait + serve)."""
+        return max(self.t_done - self.t_submit, 0.0)
+
+
+class MicrobatchQueue:
+    """Batch node-query requests into serve windows (worker thread).
+
+    ``serve_fn(ids) -> np.ndarray [len(ids), C]`` runs the forward for
+    one drained window; ``on_window(latencies)`` (optional) receives the
+    window's per-request latencies after completion — the engine feeds
+    its p99 EWMA watchdog from it.
+    """
+
+    def __init__(self, serve_fn: Callable, batch: int = 64,
+                 wait_ms: float = 2.0, on_window: Optional[Callable] = None):
+        assert batch >= 1, f"serve batch must be >= 1, got {batch}"
+        assert wait_ms >= 0.0, f"serve wait must be >= 0 ms, got {wait_ms}"
+        self._serve_fn = serve_fn
+        self._batch = int(batch)
+        self._wait_s = float(wait_ms) / 1e3
+        self._on_window = on_window
+        self._pending: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self.windows = 0
+        self.served = 0
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="roc-serve-queue")
+        self._worker.start()
+
+    # -- client side ------------------------------------------------------
+    def submit(self, node_ids: Sequence[int]) -> ServeFuture:
+        """Enqueue one request; returns a future resolving to [k, C]."""
+        import numpy as np
+        # request ingress: caller's id list -> host array.  Nothing device-
+        # resident is touched here, but the serve host-sync lint rule has
+        # no type information, so the conversion carries a waiver.
+        ids = np.asarray(node_ids, np.int32).reshape(-1)  # roclint: allow(host-sync)
+        assert ids.size >= 1, "empty query"
+        fut = ServeFuture(ids)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("queue closed")
+            self._pending.append(fut)
+            self._cv.notify()
+        return fut
+
+    def query(self, node_ids: Sequence[int], timeout: float = 60.0):
+        """Blocking submit: the request's [k, C] logits."""
+        return self.submit(node_ids).result(timeout)
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5.0)
+
+    # -- worker side ------------------------------------------------------
+    def _drain(self) -> List[ServeFuture]:
+        """One window: block for the first request, then accumulate until
+        ``batch`` total queries or ``wait_ms`` from window-open.  THE
+        sanctioned wait site — the deadline arithmetic below is the one
+        place serving is allowed a raw monotonic clock, because the wait
+        must wake on notify OR deadline and obs spans cannot time a
+        condition-variable sleep.
+        """
+        with self._cv:
+            while not self._pending and not self._closed:
+                self._cv.wait(timeout=0.1)
+            if not self._pending:
+                return []
+            # roclint: allow(raw-timing) — CV deadline, documented above
+            t0 = time.perf_counter()
+            while not self._closed:
+                n = sum(f.ids.size for f in self._pending)
+                if n >= self._batch:
+                    break
+                remaining = self._wait_s - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            window, total = [], 0
+            while self._pending and total < self._batch:
+                window.append(self._pending.popleft())
+                total += window[-1].ids.size
+            return window
+
+    def _run(self):
+        import numpy as np
+        while True:
+            window = self._drain()
+            if not window:
+                if self._closed:
+                    return
+                continue
+            try:
+                ids = np.concatenate([f.ids for f in window])
+                out = self._serve_fn(ids)
+                off = 0
+                for f in window:
+                    f._resolve(value=out[off:off + f.ids.size])
+                    off += f.ids.size
+            except Exception as e:  # resolve, don't kill the worker
+                for f in window:
+                    if not f.done():
+                        f._resolve(error=e)
+                continue
+            self.windows += 1
+            self.served += len(window)
+            if self._on_window is not None:
+                self._on_window([f.latency_s for f in window])

@@ -55,3 +55,15 @@ def load(path: str, params_like, opt_state_like
     params = _unflatten(params_like, p)
     opt_state = _unflatten(opt_state_like, o)
     return params, opt_state, meta["epoch"], meta["alpha"], meta["extra"]
+
+
+def load_params(path: str, params_like) -> Any:
+    """Params-only restore (frozen/serving paths — roc_tpu/train/frozen.py):
+    skips the optimizer arrays entirely, so an inference process never
+    materializes 2x the weights it will never step."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        assert meta["version"] == _FORMAT_VERSION, (
+            f"checkpoint version {meta['version']} != {_FORMAT_VERSION}")
+        p = {k[2:]: z[k] for k in z.files if k.startswith("p_")}
+    return _unflatten(params_like, p)

@@ -194,6 +194,16 @@ class Config:
                                   # suffixes).  Without -stream, a graph
                                   # whose resident bytes exceed it refuses
                                   # to run in-core — the out-of-core gate
+    serve_batch: int = 64         # serving microbatch cap (roc_tpu/serve):
+                                  # a queue window drains when this many
+                                  # queries accumulate, and the padded
+                                  # bucket ladder tops out here — larger
+                                  # batch = better QPS, more padding waste
+                                  # on sparse streams
+    serve_wait_ms: float = 2.0    # max ms a serving window stays open
+                                  # waiting to fill before draining — the
+                                  # latency half of the batch/wait knob
+                                  # pair; 0 drains after every request
 
     def __post_init__(self):
         # ROC_BALANCE* env overrides so driverless entry points (bench.py,
@@ -278,6 +288,22 @@ class Config:
         if env.get("ROC_PROFILE_EPOCHS"):
             self.profile_epochs = env["ROC_PROFILE_EPOCHS"]
         self.profile_window()  # validate eagerly (SystemExit if bad)
+        # ROC_SERVE_* mirror -serve-batch/-serve-wait-ms for driverless
+        # entry points (serve_bench.py, preflight's serve smoke).
+        try:
+            if "ROC_SERVE_BATCH" in env:
+                self.serve_batch = int(env["ROC_SERVE_BATCH"])
+            if "ROC_SERVE_WAIT_MS" in env:
+                self.serve_wait_ms = float(env["ROC_SERVE_WAIT_MS"])
+        except ValueError:
+            raise SystemExit("ROC_SERVE_BATCH must be an integer and "
+                             "ROC_SERVE_WAIT_MS numeric")
+        if self.serve_batch < 1:
+            raise SystemExit(f"serve_batch={self.serve_batch}: the serving "
+                             "window must admit at least one query")
+        if self.serve_wait_ms < 0:
+            raise SystemExit(f"serve_wait_ms={self.serve_wait_ms} must be "
+                             ">= 0 (0 drains after every request)")
 
     def mem_budget_bytes(self) -> int:
         """-mem-budget in bytes (0 = unset; driver falls back to the
@@ -396,6 +422,12 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-stream-budget", dest="stream_budget", default="",
                    help="aggregate device-memory budget the in-core path "
                         "is held to (e.g. 8g); larger graphs must -stream")
+    p.add_argument("-serve-batch", dest="serve_batch", type=int, default=64,
+                   help="serving microbatch cap: window drains at this "
+                        "many queries; bucket ladder tops out here")
+    p.add_argument("-serve-wait-ms", dest="serve_wait_ms", type=float,
+                   default=2.0, help="max ms a serving window waits to "
+                        "fill before draining (0 = drain per request)")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

@@ -136,6 +136,66 @@ def resolve_gat_backend(backend: str, num_edges: int) -> str:
     return "xla" if backend == "xla" else "plan"
 
 
+def model_aggrs(model: Model) -> set:
+    """Aggregation kinds the built model actually uses."""
+    return {op.attrs["aggr"] for op in model.ops if op.kind == "aggregate"}
+
+
+def model_has_gat(model: Model) -> bool:
+    return any(op.kind == "gat" for op in model.ops)
+
+
+def effective_backend(config: Config, dataset: Dataset, model: Model,
+                      use_edge_shard: bool = False) -> str:
+    """The run's aggregation backend, model-aware: the plan-based backends
+    (binned/matmul) implement sum and avg (avg = plan-sum / in-degree), so
+    don't pay plan construction when the built model contains neither.
+    Module-level (not a trainer method) because the frozen/serving loader
+    (train/frozen.py) must resolve the SAME backend as the trainer that
+    wrote the checkpoint — two copies of this policy would let an
+    inference process silently compile a different program than eval."""
+    cfg = config
+    g = dataset.graph
+    if use_edge_shard:
+        # Edge-sharded aggregation supports xla, matmul (windowed
+        # per-block one-hot plans, spmd.edge_aggregate_matmul) and,
+        # where the block-window occupancy model holds, binned
+        # (spmd.edge_aggregate_binned; falls back to matmul in
+        # _build_graph_full otherwise).  auto resolves to matmul — the
+        # binned viability bound needs the block spans, known only
+        # after the edge blocks are built.
+        backend = resolve_backend(cfg.aggregate_backend, g.num_edges)
+        if backend in ("matmul", "binned") \
+                and not ({"sum", "avg"} & model_aggrs(model)):
+            if cfg.aggregate_backend != "auto":
+                print(f"# aggregate_backend={cfg.aggregate_backend} "
+                      f"only accelerates sum/avg aggregation under "
+                      f"-edge-shard; using xla")
+            return "xla"
+        return backend
+    backend = resolve_backend(cfg.aggregate_backend, g.num_edges,
+                              g.num_nodes, g.num_nodes)
+    aggrs = model_aggrs(model)
+    if backend in ("binned", "matmul") and not ({"sum", "avg"} & aggrs):
+        if cfg.aggregate_backend != "auto" and not model_has_gat(model):
+            # (a GAT model honors the choice through the attention
+            # plan backend instead — effective_gat_backend)
+            print(f"# aggregate_backend={backend} only accelerates "
+                  f"sum/avg aggregation; this model uses "
+                  f"{sorted(aggrs)} — using xla")
+        return "xla"
+    return backend
+
+
+def effective_gat_backend(config: Config, dataset: Dataset,
+                          model: Model) -> str:
+    """Attention backend for models with gat ops ("plan" | "xla")."""
+    if not model_has_gat(model):
+        return "xla"
+    return resolve_gat_backend(config.aggregate_backend,
+                               dataset.graph.num_edges)
+
+
 def maybe_autotune(edge_src, edge_dst, num_rows: int, table_rows: int,
                    storage_dtype: str = "fp32", fuse_linear: bool = False,
                    watchdog=None, log=None):
@@ -579,53 +639,16 @@ class BaseTrainer:
         raise NotImplementedError
 
     def _effective_backend(self) -> str:
-        """The plan-based backends (binned/matmul) implement sum and avg
-        (avg = plan-sum / in-degree); don't pay plan construction when the
-        built model contains neither."""
-        cfg = self.config
-        g = self.dataset.graph
-        if self._use_edge_shard:
-            # Edge-sharded aggregation supports xla, matmul (windowed
-            # per-block one-hot plans, spmd.edge_aggregate_matmul) and,
-            # where the block-window occupancy model holds, binned
-            # (spmd.edge_aggregate_binned; falls back to matmul in
-            # _build_graph_full otherwise).  auto resolves to matmul — the
-            # binned viability bound needs the block spans, known only
-            # after the edge blocks are built.
-            backend = resolve_backend(cfg.aggregate_backend, g.num_edges)
-            if backend in ("matmul", "binned") \
-                    and not ({"sum", "avg"} & self._model_aggrs()):
-                if cfg.aggregate_backend != "auto":
-                    print(f"# aggregate_backend={cfg.aggregate_backend} "
-                          f"only accelerates sum/avg aggregation under "
-                          f"-edge-shard; using xla")
-                return "xla"
-            return backend
-        backend = resolve_backend(cfg.aggregate_backend, g.num_edges,
-                                  g.num_nodes, g.num_nodes)
-        aggrs = self._model_aggrs()
-        if backend in ("binned", "matmul") and not ({"sum", "avg"} & aggrs):
-            if cfg.aggregate_backend != "auto" and not self._model_has_gat():
-                # (a GAT model honors the choice through the attention
-                # plan backend instead — _gat_backend)
-                print(f"# aggregate_backend={backend} only accelerates "
-                      f"sum/avg aggregation; this model uses "
-                      f"{sorted(aggrs)} — using xla")
-            return "xla"
-        return backend
+        return effective_backend(self.config, self.dataset, self.model,
+                                 use_edge_shard=self._use_edge_shard)
 
     def _gat_backend(self) -> str:
-        """Attention backend for models with gat ops ("plan" | "xla")."""
-        if not self._model_has_gat():
-            return "xla"
-        return resolve_gat_backend(self.config.aggregate_backend,
-                                   self.dataset.graph.num_edges)
+        return effective_gat_backend(self.config, self.dataset, self.model)
 
     def _model_aggrs(self) -> set:
         """Aggregation kinds the built model actually uses (backend and
         edge-shard selection both key off this)."""
-        return {op.attrs["aggr"] for op in self.model.ops
-                if op.kind == "aggregate"}
+        return model_aggrs(self.model)
 
     def _aggregate_widths(self) -> list:
         """Feature width at each aggregate/gat op, in op order — the widths

@@ -16,9 +16,10 @@ import os
 import numpy as np
 import pytest
 
-from roc_tpu.analysis import (AuditSpec, audit_specs, audit_trainer,
-                              build_audit_trainer, check_invariants,
-                              compare_report, load_budgets, spec_key)
+from roc_tpu.analysis import (AuditSpec, audit_spec, audit_specs,
+                              audit_trainer, build_audit_trainer,
+                              check_invariants, compare_report,
+                              load_budgets, spec_key)
 from roc_tpu.analysis import lint, retrace
 from roc_tpu.analysis.retrace import RetraceError, RetraceGuard
 
@@ -41,8 +42,10 @@ def test_manifest_covers_matrix(budgets):
 @pytest.mark.parametrize("spec", audit_specs(), ids=spec_key)
 def test_audit_clean_tree(spec, budgets):
     """Every model x parts x backend x exchange entry lowers to exactly
-    its budgeted collectives, with no f64 and unchanged shardings."""
-    rep = audit_trainer(build_audit_trainer(spec), key=spec_key(spec))
+    its budgeted collectives, with no f64 and unchanged shardings.
+    `audit_spec` dispatches: trainer steps for training entries, the
+    serving engine's bucketed serve_step for the `serve` rows."""
+    rep = audit_spec(spec, key=spec_key(spec))
     assert compare_report(rep, budgets[spec_key(spec)]) == []
     assert check_invariants(rep) == []
 

@@ -216,6 +216,26 @@ ROC_BENCH_STREAM=1 ROC_STREAM_SLOTS=2 ROC_BENCH_EPOCHS=5 \
 # driver-path smoke on real hardware: >2x-budget rotation + live obs
 timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 10 -parts 4 -stream -stream-slots 2 -v 2>&1 | tail -3 | tee -a "$LOG"
+
+note "5b. serving latency/throughput on the chip (roc_tpu/serve): warm-"
+note "    cache cold start (plan_builds must be 0), then open-loop p50/p99"
+note "    at stepped offered QPS — record the knee (where p99 detaches"
+note "    from p50) and the cold start in docs/PERF.md's serving table,"
+note "    and compare measured p50 against the roofline forward-time"
+note "    prediction (the serve-p50 ledger pair in the calibration report)"
+timeout 1200 env ROC_SERVE_BENCH_DATASET=reddit-small \
+    ROC_SERVE_BENCH_REQUESTS=500 ROC_SERVE_BENCH_QPS=50 \
+    python tools/serve_bench.py 2>&1 | tail -2 | tee -a "$LOG"
+for qps in 100 200 400; do
+    note "   offered qps=$qps"
+    timeout 1200 env ROC_SERVE_BENCH_DATASET=reddit-small \
+        ROC_SERVE_BENCH_REQUESTS=500 ROC_SERVE_BENCH_QPS=$qps \
+        python tools/serve_bench.py 2>&1 | tail -1 | tee -a "$LOG"
+done
+# riding-along capture on the canonical bench shape (serve block in the
+# bench artifact; excluded from vs_baseline / the canonical persist)
+ROC_BENCH_SERVE=1 ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 6 ]; then

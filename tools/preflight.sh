@@ -94,6 +94,22 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m roc_tpu \
     -stream -stream-slots 2 -eval-every 100 >/dev/null || {
     echo "preflight: streamed smoke RED" >&2; exit 1; }
 
+# Serve smoke: cold start from a warm plan cache (zero plan rebuilds,
+# asserted), ~100 mixed-batch-size queries on the tiny CPU dataset with
+# served-vs-eval parity <= 32 ULPs and zero retraces after warmup — the
+# serving contracts, end-to-end in one process (roc_tpu/serve/__main__).
+echo "== serve smoke =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m roc_tpu.serve --selftest >/dev/null || {
+    echo "preflight: serve smoke RED" >&2; exit 1; }
+# Serving bench artifact: tools/serve_bench.py must emit a BENCH_SERVE
+# payload that passes the perf-ledger schema gate (tmp root — the real
+# BENCH_SERVE.json is only written by an actual bench invocation).
+echo "== serve bench selftest =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/serve_bench.py --selftest || {
+    echo "preflight: serve bench selftest RED" >&2; exit 1; }
+
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Serving bench: p50/p99 latency at offered QPS + cold-start artifact.
+
+Emits `BENCH_SERVE.json` (schema gated by `tools/perf_ledger.py --check`
+and folded into BENCH_TRAJECTORY.json under its own "serve" key — NEVER
+a training-claim round row):
+
+  {"metric": "serve_p50", "value": ..., "unit": "s",
+   "p50_s": ..., "p99_s": ..., "qps_offered": ..., "qps_achieved": ...,
+   "cold_start_s": ..., "plan_builds": ..., "platform": ...,
+   "measured_at": ...}
+
+The cold start reported is the WARM-cache cold start (the serving
+contract: cache load + one trace, zero plan rebuilds).  The first engine
+build of a fresh checkout populates the plan cache; the bench then tears
+it down and times a second build, which is the number a restarting
+replica would see.  The load phase is open-loop (roc_tpu/serve/loadgen)
+so overload shows up in the tail instead of throttling the offer rate.
+
+  python tools/serve_bench.py                 # bench, write BENCH_SERVE.json
+  python tools/serve_bench.py --selftest      # tiny CPU run into a tmp
+                                              # root, schema-validated via
+                                              # perf_ledger.check (preflight)
+
+Knobs (env, matching bench.py's style): ROC_SERVE_BENCH_DATASET,
+ROC_SERVE_BENCH_REQUESTS, ROC_SERVE_BENCH_QPS, ROC_SERVE_BATCH,
+ROC_SERVE_WAIT_MS, ROC_SERVE_BENCH_CKPT (optional checkpoint to serve).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _env(name, default, cast):
+    try:
+        return cast(os.environ.get(name, default))
+    except ValueError:
+        raise SystemExit(f"{name} must be {cast.__name__}")
+
+
+def run_bench(dataset: str, n_requests: int, qps: float,
+              ckpt: str = "") -> dict:
+    """Build engine (twice — populate then warm-start), offer load,
+    return the BENCH_SERVE payload."""
+    import jax
+
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_model
+    from roc_tpu.serve import ServeEngine, run_load
+    from roc_tpu.train.config import Config
+
+    cfg = Config(dataset=dataset, layers=[], model="gcn")
+    ds = datasets.get(dataset, seed=cfg.seed)
+    cfg.layers = [ds.features.shape[1], 16, ds.num_classes]
+    model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
+                        heads=cfg.heads)
+
+    # first build populates the content-keyed plan cache (and jit cache
+    # for this process — so the warm timing below is generous on trace
+    # time; plan_builds is the honest zero-rebuild pin)
+    ServeEngine(cfg, ds, model, checkpoint_path=ckpt or None,
+                start_queue=False).close()
+
+    with ServeEngine(cfg, ds, model, checkpoint_path=ckpt or None) as eng:
+        eng.warmup()
+        stats = run_load(eng, n_requests=n_requests, qps=qps)
+        cs = eng.cold_start_stats
+        payload = {
+            "metric": "serve_p50",
+            "value": stats["p50_s"],
+            "unit": "s",
+            "p50_s": stats["p50_s"],
+            "p99_s": stats["p99_s"],
+            "mean_s": stats["mean_s"],
+            "n_requests": stats["n"],
+            "qps_offered": stats["qps_offered"],
+            "qps_achieved": stats["qps_achieved"],
+            "cold_start_s": cs["cold_start_s"],
+            "plan_builds": cs["plan_builds"],
+            "serve_batch": cfg.serve_batch,
+            "serve_wait_ms": cfg.serve_wait_ms,
+            "buckets": cs["buckets"],
+            "platform": jax.default_backend(),
+            # artifact timestamp, not a measurement record (the ledger
+            # pairing lives in the engine); mirrors bench.py's waiver
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),  # roclint: allow(unledgered-prediction)
+        }
+    return payload
+
+
+def write_artifact(payload: dict, root: str = ROOT) -> str:
+    path = os.path.join(root, "BENCH_SERVE.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def selftest() -> int:
+    """Tiny CPU end-to-end into a tmp root; the artifact must pass the
+    perf-ledger schema gate byte-for-byte as a real run's would."""
+    tmp = tempfile.mkdtemp(prefix="roc_serve_bench_")
+    os.environ["ROC_PLAN_CACHE_DIR"] = os.path.join(tmp, "plan_cache")
+    os.environ["ROC_PLAN_CACHE_MIN_EDGES"] = "0"
+    os.environ.setdefault("ROC_SERVE_BATCH", "8")
+    os.environ.setdefault("ROC_SERVE_WAIT_MS", "1.0")
+    payload = run_bench("roc-audit", n_requests=40, qps=500.0)
+    path = write_artifact(payload, root=tmp)
+    assert payload["plan_builds"] == 0, (
+        f"warm cold start rebuilt {payload['plan_builds']} plan(s)")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import perf_ledger
+    errs = perf_ledger.check(root=tmp)
+    assert not errs, f"BENCH_SERVE.json failed the schema gate: {errs}"
+    print(f"# serve_bench selftest: OK — p50={payload['p50_s'] * 1e3:.2f}ms "
+          f"p99={payload['p99_s'] * 1e3:.2f}ms at "
+          f"{payload['qps_offered']} qps offered, warm cold start "
+          f"{payload['cold_start_s']:.3f}s, plan_builds=0 ({path})")
+    return 0
+
+
+def main(argv) -> int:
+    if "--selftest" in argv:
+        return selftest()
+    payload = run_bench(
+        _env("ROC_SERVE_BENCH_DATASET", "roc-audit", str),
+        _env("ROC_SERVE_BENCH_REQUESTS", "200", int),
+        _env("ROC_SERVE_BENCH_QPS", "100.0", float),
+        ckpt=os.environ.get("ROC_SERVE_BENCH_CKPT", ""))
+    path = write_artifact(payload)
+    print(json.dumps(payload))
+    print(f"# serve_bench: wrote {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
