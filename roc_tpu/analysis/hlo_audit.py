@@ -175,7 +175,7 @@ def lower_steps(trainer) -> Dict[str, object]:
     alpha = jnp.float32(trainer.optimizer.alpha)
     lo_train = trainer._train_step.lower(
         trainer.params, trainer.opt_state, trainer.x, trainer.labels,
-        trainer.mask, trainer.gdata, rng, alpha)
+        trainer.mask, trainer.gdata, rng, alpha, jnp.float32(1.0))
     lo_eval = trainer._eval_step.lower(
         trainer.params, trainer.x, trainer.labels, trainer.mask,
         trainer.gdata)

@@ -61,6 +61,16 @@ preceding line):
     serialize already-ledgered values for human-facing JSON, they are
     not new prediction sites.
 
+``silent-swallow``
+    An ``except:`` handler whose entire body is ``pass``/``continue`` —
+    the error vanishes without a log line, a counter, or a comment that
+    survives review.  A fault-tolerant runtime is allowed to *drop* an
+    error only where the drop is deliberate and visible (warn-once +
+    counted, like the plan-cache save path, or an obs JSONL event);
+    everything else either propagates or carries a waiver stating why
+    swallowing is correct.  Tests are exempt (fixtures poke error paths
+    on purpose).
+
 ``hand-rolled-geometry``
     A ``Geometry(...)`` constructor call outside the sanctioned sites —
     the kernel module that owns the presets
@@ -275,7 +285,31 @@ class _FileLint:
         self._rule_unledgered_prediction()
         self._rule_hand_rolled_geometry()
         self._rule_serve_sync()
+        self._rule_silent_swallow()
         return self.findings
+
+    def _rule_silent_swallow(self):
+        """``except: pass`` / ``except: continue`` with no logging — the
+        error disappears untraced.  Flags the handler's first body
+        statement, so a waiver works on the ``pass`` line, the comment
+        directly above it, or the ``except`` line when ``pass`` follows
+        immediately.  Tests are exempt."""
+        p = self.path.replace("/", os.sep)
+        if "tests" + os.sep in p or \
+                os.path.basename(p).startswith("test_"):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.body and all(isinstance(s, (ast.Pass, ast.Continue))
+                                 for s in node.body):
+                kind = _dotted(node.type) if node.type is not None \
+                    else "bare except"
+                self._flag(node.body[0], "silent-swallow",
+                           f"except handler ({kind}) swallows the error "
+                           f"with no log/counter; emit a warn-once or obs "
+                           f"event, or waive with a rationale for why "
+                           f"dropping it is correct")
 
     def _rule_serve_sync(self):
         """Sync-shaped calls in roc_tpu/serve/ (see _SERVE_DIR note)."""

@@ -90,7 +90,8 @@ class TelemetryBuffer:
             with open(self.trace_path, "a") as f:
                 f.write(json.dumps(obj, default=_jsonable) + "\n")
         except OSError:
-            pass  # tracing must never take down training
+            # roclint: allow(silent-swallow) — tracing must never take down training
+            pass
 
     # -- reading ----------------------------------------------------------
     def samples(self, kinds: Iterable[str] = ("probe",)) -> List[ShardSample]:

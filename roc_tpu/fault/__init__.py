@@ -1,0 +1,36 @@
+"""Fault-tolerant runtime: chaos injection, retries, durability, guard.
+
+  inject.py   deterministic seeded fault injection — `point("site")`
+              hooks, armed via ROC_FAULT / -fault, no-op otherwise
+  retry.py    bounded jittered-exponential retry (`retrying`), per-site
+              counters surfaced in the obs JSONL
+  durable.py  fsync-before-rename (`fsync_replace`) shared by every
+              atomic writer in the tree
+  guard.py    in-graph non-finite step guard (`guarded_update`) — skip-
+              step via jnp.where, zero syncs/retraces  [imports jax]
+
+`python -m roc_tpu.fault --selftest` is the seeded chaos smoke wired
+into tools/preflight.sh.  The core three modules are stdlib-only so
+graph/lux.py (numpy + stdlib) can import them; guard is lazy here for
+the same reason.
+"""
+
+from roc_tpu.fault.durable import fsync_replace
+from roc_tpu.fault.inject import (InjectedFault, SimulatedCrash, armed,
+                                  attach, configure, counters, detach,
+                                  emit_event, parse_spec, point, spec)
+from roc_tpu.fault.retry import reset_retry_counts, retry_counts, retrying
+
+__all__ = [
+    "InjectedFault", "SimulatedCrash", "armed", "attach", "configure",
+    "counters", "detach", "emit_event", "fsync_replace", "guarded_update",
+    "nan_scale", "parse_spec", "point", "reset_retry_counts",
+    "retry_counts", "retrying", "spec",
+]
+
+
+def __getattr__(name):
+    if name in ("guarded_update", "nan_scale"):
+        from roc_tpu.fault import guard
+        return getattr(guard, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

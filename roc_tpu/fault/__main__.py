@@ -1,0 +1,188 @@
+"""`python -m roc_tpu.fault --selftest`: the fault harness's own gate.
+
+Run by tools/preflight.sh so a broken chaos harness is caught before
+anyone trusts a green chaos run ("the faults didn't fire" and "the
+faults fired and were survived" look identical from the outside).  Five
+stages, all deterministic and CPU-cheap:
+
+  1. spec      — parse/validation + seeded per-call determinism
+  2. retry     — recovery, exhaustion, and the retries=0 kill switch
+  3. durable   — fsync_replace atomic-rename round trip
+  4. guard     — jitted non-finite skip keeps params bitwise
+  5. chaos     — a seeded mini-train with an injected NaN step completes
+                 with finite params, plus a serve-queue shed/drain smoke
+
+Exit 0 and print "fault selftest: OK" on success; any assertion failure
+exits nonzero with the stage name in the traceback.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+
+def _stage_spec():
+    from roc_tpu.fault import inject
+    seed, retries, slow_s, rules = inject.parse_spec(
+        "seed=7,retries=2,slow_ms=1.5,a.read=3,b.kill=perm,c.nan@0.5")
+    assert seed == 7 and retries == 2 and abs(slow_s - 0.0015) < 1e-12
+    assert set(rules) == {"a.read", "b.kill", "c.nan"}
+    for bad in ("nonsense==", "x@1.5", "seed=abc"):
+        try:
+            inject.parse_spec(bad)
+        except ValueError:
+            pass  # roclint: allow(silent-swallow) — expected-failure fixture
+        else:
+            raise AssertionError(f"parse_spec accepted {bad!r}")
+    # seeded probability sites fire the same calls across re-arms
+    def fire_pattern():
+        inject.configure("seed=11,p.nan@0.5")
+        return [inject.point("p.nan") for _ in range(64)]
+    a, b = fire_pattern(), fire_pattern()
+    assert a == b and any(a) and not all(a), "seeded firing not deterministic"
+    inject.configure("")
+
+
+def _stage_retry():
+    from roc_tpu.fault import inject, retry
+    inject.configure("seed=1,r.io=2")
+    calls = []
+
+    def flaky():
+        inject.point("r.io")
+        calls.append(1)
+        return "ok"
+    assert retry.retrying("r.io", flaky, base_s=0.001) == "ok"
+    assert retry.retry_counts().get("r.io") == 2
+    inject.configure("seed=1,r.perm=perm")
+    try:
+        retry.retrying("r.perm", lambda: inject.point("r.perm"),
+                       base_s=0.001)
+    except inject.InjectedFault:
+        pass  # roclint: allow(silent-swallow) — expected-failure fixture
+    else:
+        raise AssertionError("permanent fault did not exhaust the retry")
+    # retries=0 is the chaos kill switch: first failure propagates
+    inject.configure("seed=1,retries=0,r.once=1")
+    tries = []
+
+    def once():
+        tries.append(1)
+        inject.point("r.once")
+    try:
+        retry.retrying("r.once", once, base_s=0.001)
+    except inject.InjectedFault:
+        pass  # roclint: allow(silent-swallow) — expected-failure fixture
+    else:
+        raise AssertionError("retries=0 still retried")
+    assert len(tries) == 1
+    inject.configure("")
+    retry.reset_retry_counts()
+
+
+def _stage_durable():
+    from roc_tpu.fault import fsync_replace
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "blob.bin")
+        with open(path, "wb") as f:
+            f.write(b"old")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(b"new contents")
+        fsync_replace(tmp, path)
+        assert not os.path.exists(tmp)
+        with open(path, "rb") as f:
+            assert f.read() == b"new contents"
+
+
+def _stage_guard():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from roc_tpu.fault import guarded_update
+    from roc_tpu.optim.adam import Adam
+    opt = Adam(alpha=0.1)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, g):
+        return guarded_update(opt, p, g, s, jnp.float32(0.1))
+
+    p1, s1, nf1, _ = step(params, state, {"w": jnp.full((4,), 0.5)})
+    assert not bool(nf1) and not np.allclose(np.asarray(p1["w"]), 1.0)
+    p2, s2, nf2, _ = step(params, state, {"w": jnp.full((4,), np.nan)})
+    assert bool(nf2), "NaN grads not flagged"
+    np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                  np.asarray(params["w"]))
+    np.testing.assert_array_equal(np.asarray(s2.m["w"]),
+                                  np.asarray(state.m["w"]))
+    del p1, s1
+
+
+def _stage_chaos():
+    import numpy as np
+    from roc_tpu.fault import inject
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_gcn
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import Trainer
+    ds = datasets.synthetic("selftest", 80, 3.0, 8, 3, n_train=20,
+                            n_val=20, n_test=20, seed=13)
+    cfg = Config(layers=[8, 4, 3], num_epochs=4, eval_every=1000,
+                 dropout_rate=0.0)
+    inject.configure("seed=3,step.nan=1")
+    try:
+        tr = Trainer(cfg, ds, build_gcn(cfg.layers, 0.0))
+        stats = tr.train(print_fn=lambda *_: None)
+    finally:
+        inject.configure("")
+    assert tr._nf_skips >= 1, "injected NaN step was not skipped"
+    assert np.isfinite(stats.final_loss), "NaN leaked into the params"
+    for leaf in np.asarray(tr.params["linear_0"]).ravel()[:4]:
+        assert np.isfinite(leaf)
+
+    # serve-queue overload smoke: shed at the cap, graceful drain
+    from roc_tpu.serve.queue import MicrobatchQueue, Overloaded
+    release, started = threading.Event(), threading.Event()
+
+    def serve_fn(ids):
+        started.set()
+        release.wait(5.0)
+        return np.zeros((len(ids), 3), np.float32)
+
+    q = MicrobatchQueue(serve_fn, batch=4, wait_ms=1.0, queue_max=1)
+    f1 = q.submit([1])
+    assert started.wait(5.0), "serve worker never picked up the window"
+    f2 = q.submit([2])          # fills the single pending slot
+    try:
+        q.submit([3])
+    except Overloaded:
+        pass  # roclint: allow(silent-swallow) — expected-failure fixture
+    else:
+        raise AssertionError("submit past queue_max did not shed")
+    release.set()
+    q.close()
+    assert f1.result(5.0).shape == (1, 3)
+    assert f2.result(5.0).shape == (1, 3)
+    assert q.shed == 1
+
+
+def main(argv):
+    if "--selftest" not in argv:
+        print(__doc__.strip())
+        return 0
+    for name, fn in (("spec", _stage_spec), ("retry", _stage_retry),
+                     ("durable", _stage_durable), ("guard", _stage_guard),
+                     ("chaos", _stage_chaos)):
+        fn()
+        print(f"# fault selftest: {name} ok")
+    print("fault selftest: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,59 @@
+"""In-graph non-finite step guard (jax side of roc_tpu/fault).
+
+A NaN/Inf loss or gradient must not poison the params — but detecting
+it on the host would cost a device->host sync per step, and branching
+on it in Python would retrace.  So the guard lives *inside* the jitted
+update: compute the update unconditionally, then ``jnp.where``-select
+between the new and old params/optimizer state on a single finiteness
+scalar.  The step function's signature and output treedef are fixed at
+trace time — the skip is pure data flow, zero retraces — and the
+``nonfinite`` flag rides the step's return pytree next to the metrics
+channel, fetched by the driver in the same once-per-epoch device_get
+it already pays for the loss.
+
+Kept in its own module so the stdlib-only fault core (inject/retry/
+durable — imported by graph/lux.py) never pulls jax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from roc_tpu.obs.channel import global_norm
+
+
+def guarded_update(optimizer, params, grads, opt_state, alpha,
+                   loss=None):
+    """Apply ``optimizer.update`` only if loss and grads are finite.
+
+    Returns ``(params, opt_state, nonfinite, grad_norm)`` where
+    ``nonfinite`` is a traced bool scalar (True = this step was
+    skipped: params AND the full optimizer state — Adam m/v/t — keep
+    their pre-step values, so a skipped step is a true no-op).
+    ``grad_norm`` is the fp32 global grad norm, reusable by the
+    metrics channel so the guard adds no extra reduction.
+    """
+    gnorm = global_norm(grads)
+    finite = jnp.isfinite(gnorm)
+    if loss is not None:
+        finite = jnp.logical_and(finite, jnp.isfinite(loss))
+    new_params, new_opt = optimizer.update(params, grads, opt_state,
+                                           alpha)
+    def sel(new, old):
+        return jnp.where(finite, new, old)
+    out_params = jax.tree.map(sel, new_params, params)
+    out_opt = jax.tree.map(sel, new_opt, opt_state)
+    return out_params, out_opt, jnp.logical_not(finite), gnorm
+
+
+def nan_scale(site: str = "step.nan"):
+    """Host-side helper: the loss scale for this step — 1.0 normally,
+    NaN when the chaos harness fires the ``step.nan`` site.  Always the
+    same shape/dtype, so it feeds the jitted step as a plain argument
+    without keying a new trace."""
+    from roc_tpu.fault import inject
+    import numpy as np
+    if inject.point(site):
+        return np.float32(np.nan)
+    return np.float32(1.0)

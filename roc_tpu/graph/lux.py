@@ -26,6 +26,7 @@ import os
 
 import numpy as np
 
+from roc_tpu import fault
 from roc_tpu.graph.csr import Csr, E_DTYPE, V_DTYPE
 
 MASK_TRAIN, MASK_VAL, MASK_TEST, MASK_NONE = 0, 1, 2, 3
@@ -72,16 +73,25 @@ def read_rows_slice(path: str, lo: int, hi: int) -> np.ndarray:
         raise ValueError(f".lux row range [{lo}, {hi}) runs past the end "
                          f"of {path} ({num_nodes} nodes)")
     from roc_tpu import native
-    if native.available():
-        rows, _ = native.lux_read_slice(path, lo, hi, 0, 0)
+
+    def _read():
+        # Retried as one unit (roc_tpu/fault): the seek+read is
+        # idempotent, and a short read (NFS hiccup, torn write seen
+        # mid-replace) surfaces as the ValueError below — transient by
+        # construction, so it retries alongside real OSErrors.
+        fault.point("lux.read")
+        if native.available():
+            rows, _ = native.lux_read_slice(path, lo, hi, 0, 0)
+            return rows
+        with open(path, "rb") as f:
+            f.seek(_HEADER_SIZE + 8 * lo)
+            rows = np.fromfile(f, dtype=np.uint64, count=hi - lo)
+        if rows.shape[0] != hi - lo:
+            raise ValueError(f".lux row range [{lo}, {hi}) runs past the "
+                             f"end of {path} (got {rows.shape[0]} offsets)")
         return rows
-    with open(path, "rb") as f:
-        f.seek(_HEADER_SIZE + 8 * lo)
-        rows = np.fromfile(f, dtype=np.uint64, count=hi - lo)
-    if rows.shape[0] != hi - lo:
-        raise ValueError(f".lux row range [{lo}, {hi}) runs past the end "
-                         f"of {path} (got {rows.shape[0]} offsets)")
-    return rows
+    return fault.retrying("lux.read", _read,
+                          retry_on=(OSError, ValueError))
 
 
 def read_cols_slice(path: str, num_nodes: int, e0: int, e1: int
@@ -95,16 +105,21 @@ def read_cols_slice(path: str, num_nodes: int, e0: int, e1: int
         raise ValueError(f".lux edge range [{e0}, {e1}) runs past the end "
                          f"of {path} ({num_edges} edges)")
     from roc_tpu import native
-    if native.available():
-        _, cols = native.lux_read_slice(path, 0, 0, e0, e1)
+
+    def _read():
+        fault.point("lux.read")
+        if native.available():
+            _, cols = native.lux_read_slice(path, 0, 0, e0, e1)
+            return cols
+        with open(path, "rb") as f:
+            f.seek(_HEADER_SIZE + 8 * num_nodes + 4 * e0)
+            cols = np.fromfile(f, dtype=np.uint32, count=e1 - e0)
+        if cols.shape[0] != e1 - e0:
+            raise ValueError(f".lux edge range [{e0}, {e1}) runs past the "
+                             f"end of {path} (got {cols.shape[0]} ids)")
         return cols
-    with open(path, "rb") as f:
-        f.seek(_HEADER_SIZE + 8 * num_nodes + 4 * e0)
-        cols = np.fromfile(f, dtype=np.uint32, count=e1 - e0)
-    if cols.shape[0] != e1 - e0:
-        raise ValueError(f".lux edge range [{e0}, {e1}) runs past the end "
-                         f"of {path} (got {cols.shape[0]} ids)")
-    return cols
+    return fault.retrying("lux.read", _read,
+                          retry_on=(OSError, ValueError))
 
 
 def read_lux(path: str) -> Csr:
@@ -159,10 +174,12 @@ def _cache_fresh(bin_path: str, src_path: str) -> bool:
 
 def _atomic_tofile(arr: np.ndarray, path: str) -> None:
     """Write-then-rename so concurrent readers (multihost processes on
-    shared storage) never observe a truncated cache file."""
+    shared storage) never observe a truncated cache file; fsync on both
+    sides of the rename (fault.fsync_replace) so a kill/power-loss never
+    leaves a correctly-named file with unflushed garbage behind it."""
     tmp = f"{path}.tmp.{os.getpid()}"
     arr.tofile(tmp)
-    os.replace(tmp, path)
+    fault.fsync_replace(tmp, path)
 
 
 def load_features(prefix: str, num_nodes: int, in_dim: int,

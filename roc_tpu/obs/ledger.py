@@ -190,6 +190,7 @@ def join(records: List[dict]) -> List[dict]:
             try:
                 pending[(r["model"], r["key"])] = float(r["value"])
             except (KeyError, TypeError, ValueError):
+                # roclint: allow(silent-swallow) — malformed record never pairs
                 pass
         elif t == "measurement":
             if "ratio" in r:

@@ -133,7 +133,9 @@ def load_jsonl(path: str) -> List[dict]:
                 try:
                     out.append(json.loads(line))
                 except ValueError:
+                    # roclint: allow(silent-swallow) — torn JSONL tail post-crash
                     continue
     except OSError:
+        # roclint: allow(silent-swallow) — absent stream = no records
         pass
     return out

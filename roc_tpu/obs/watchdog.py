@@ -69,6 +69,28 @@ class PerfWatchdog:
                                  float(calibration_band[1]))
         self.calib_ewma: dict = {}
         self.calib_observed: dict = {}
+        # non-finite step guard feed (roc_tpu/fault): total skipped steps
+        self.nonfinite_steps = 0
+
+    # -- checkpoint round trip (roc_tpu/fault crash-consistent resume) ----
+    _STATE_KEYS = ("ewma", "observed", "seeded", "stall_ewma",
+                   "stall_observed", "serve_ewma", "serve_observed",
+                   "calib_ewma", "calib_observed", "nonfinite_steps")
+
+    def state_dict(self) -> dict:
+        """JSON-able EWMA state for the checkpoint `extra` record, so a
+        resumed run's watchdog is armed from epoch one instead of
+        re-warming (and judging post-resume epochs against nothing)."""
+        return {k: getattr(self, k) for k in self._STATE_KEYS}
+
+    def load_state(self, state: dict) -> None:
+        """Restore `state_dict` output; unknown/missing keys ignored (old
+        checkpoints predate the watchdog extra)."""
+        if not isinstance(state, dict):
+            return
+        for k in self._STATE_KEYS:
+            if k in state:
+                setattr(self, k, state[k])
 
     def observe_epoch(self, epoch: int, wall_s: float) -> Optional[dict]:
         """Feed one epoch's wall time; returns an alert dict or None."""
@@ -144,6 +166,19 @@ class PerfWatchdog:
         self.serve_observed += 1
         return alert
 
+    def observe_nonfinite(self, epoch: int,
+                          consecutive: int) -> Optional[dict]:
+        """Feed one skipped (non-finite loss/grad) step from the in-graph
+        guard (roc_tpu/fault).  Always alerts — a NaN step is never
+        expected behavior — with the current consecutive-skip streak so
+        the escalation ladder's state is visible in the JSONL."""
+        self.nonfinite_steps += 1
+        alert = {"kind": "nonfinite", "epoch": int(epoch),
+                 "consecutive": int(consecutive),
+                 "total": int(self.nonfinite_steps)}
+        self.alerts.append(alert)
+        return alert
+
     def observe_shards(self, epoch: int, times_s) -> List[dict]:
         """Feed per-shard probe times (balance/manager.py's samples);
         returns straggler alerts (possibly empty)."""
@@ -190,10 +225,13 @@ class PerfWatchdog:
         return alert
 
     def verdict(self) -> str:
-        """"regressed" if any slow-epoch fired, then "straggler", then
+        """"nonfinite" outranks everything (numerics beat perf), then
+        "regressed" if any slow-epoch fired, then "straggler", then
         "stream-stall", then "serve-latency", then "calibration-drift",
         "ok" otherwise — stamped into bench artifacts."""
         kinds = {a["kind"] for a in self.alerts}
+        if "nonfinite" in kinds:
+            return "nonfinite"
         if "slow-epoch" in kinds:
             return "regressed"
         if "straggler" in kinds:
@@ -236,5 +274,8 @@ def seed_for_graph(num_rows: int, num_edges: int,
                 if geo:
                     return float(geo["steps_total"]) * _CHUNK_OVERHEAD_S
     except (OSError, ValueError, KeyError, ImportError):
+        # seeding is strictly best-effort: no budgets file / unpinned
+        # shape degrades to measured-epoch warmup, the documented
+        # fallback, not an error  # roclint: allow(silent-swallow)
         pass
     return None

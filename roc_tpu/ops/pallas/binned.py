@@ -1305,8 +1305,24 @@ def _plan_cache_load(path, num_rows, table_rows, geom):
         return None
 
 
+# Process-wide count of failed plan-cache saves.  A save failure is
+# deliberately non-fatal (the plan is already in memory; only the NEXT
+# process pays a rebuild) but it must not be silent either: a full disk
+# or bad permissions turns every future cold start into a minutes-long
+# rebuild.  Warn once per process, count every failure, and emit an obs
+# JSONL record when a metrics sink is attached (roc_tpu/fault).
+_PLAN_CACHE_SAVE_ERRORS = 0
+_PLAN_CACHE_SAVE_WARNED = False
+
+
+def plan_cache_save_errors() -> int:
+    """How many plan-cache saves failed in this process (monotone)."""
+    return _PLAN_CACHE_SAVE_ERRORS
+
+
 def _plan_cache_save(path, plan: BinnedPlan) -> None:
-    """Best-effort atomic save (tmp + rename); failures never propagate."""
+    """Best-effort durable save (tmp + fsync + rename); failures don't
+    propagate — they warn once, count, and land in the obs JSONL."""
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".{os.getpid()}.tmp.npz"   # savez keeps .npz as-is
@@ -1328,9 +1344,22 @@ def _plan_cache_save(path, plan: BinnedPlan) -> None:
         else:
             arrays["p1_off"] = np.asarray(plan.p1_off)
         np.savez(tmp, **arrays)
-        os.replace(tmp, path)
-    except Exception:
-        pass
+        from roc_tpu.fault import fsync_replace
+        fsync_replace(tmp, path)
+    except Exception as e:
+        global _PLAN_CACHE_SAVE_ERRORS, _PLAN_CACHE_SAVE_WARNED
+        _PLAN_CACHE_SAVE_ERRORS += 1
+        from roc_tpu import fault as _fault
+        _fault.emit_event("plan_cache_save_error", path=str(path),
+                          error=f"{type(e).__name__}: {e}")
+        if not _PLAN_CACHE_SAVE_WARNED:
+            _PLAN_CACHE_SAVE_WARNED = True
+            warnings.warn(
+                f"binned plan-cache save to {path!r} failed "
+                f"({type(e).__name__}: {e}); this run is unaffected but "
+                f"the next cold start will rebuild the plan from scratch "
+                f"(warning once; subsequent failures are counted in "
+                f"plan_cache_save_errors() and the obs JSONL)")
 
 
 def _build_binned_plan_numpy(edge_src: np.ndarray, edge_dst: np.ndarray,

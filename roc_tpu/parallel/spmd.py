@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from roc_tpu import obs, ops
+from roc_tpu import fault, obs, ops
 from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph.partition import (Partition, edge_block_arrays,
                                      edge_block_arrays_t, partition_graph)
@@ -1785,15 +1785,16 @@ class SpmdTrainer(BaseTrainer):
                             "bytes")
             metric_specs = {"grad_norm": P(), "param_norm": P(),
                             "wire_bytes": P(), "edges": P(PARTS_AXIS)}
-            step_out_specs = (P(), P(), P(), metric_specs)
+            step_out_specs = (P(), P(), P(), P(), metric_specs)
         else:
-            step_out_specs = (P(), P(), P())
+            step_out_specs = (P(), P(), P(), P())
 
         @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
                  in_specs=(P(), P(), P(PARTS_AXIS), P(PARTS_AXIS),
-                           P(PARTS_AXIS), gd_specs, P(), P()),
+                           P(PARTS_AXIS), gd_specs, P(), P(), P()),
                  out_specs=step_out_specs)
-        def step_shard(params, opt_state, x, labels, mask, gd, key, alpha):
+        def step_shard(params, opt_state, x, labels, mask, gd, key, alpha,
+                       gscale):
             # this body only runs while jax traces it — a retrace counter
             _retrace.note_trace("train_step")
             # per-device dropout masks: fold the device index into the key
@@ -1805,12 +1806,18 @@ class SpmdTrainer(BaseTrainer):
             grads = jax.tree.map(lambda g: jax.lax.psum(g, PARTS_AXIS),
                                  grads_l)
             loss = jax.lax.psum(loss_l, PARTS_AXIS)
-            new_params, new_opt = optimizer.update(params, grads, opt_state,
-                                                   alpha)
+            # gscale is 1.0 on healthy steps (exact multiply); the chaos
+            # harness feeds NaN to exercise the non-finite guard.  Applied
+            # AFTER the psums so loss/grads are already replicated and the
+            # guard's skip decision is identical on every device.
+            loss = loss * gscale
+            grads = jax.tree.map(lambda g: g * gscale, grads)
+            new_params, new_opt, nonfinite, gnorm = fault.guarded_update(
+                optimizer, params, grads, opt_state, alpha, loss=loss)
             if not obs_on:
-                return new_params, new_opt, loss
+                return new_params, new_opt, loss, nonfinite
             metrics = {
-                "grad_norm": obs.channel.global_norm(grads),
+                "grad_norm": gnorm,
                 "param_norm": obs.channel.global_norm(new_params),
                 # float32: exact for any realistic per-step byte count's
                 # leading digits, and immune to the x64-disabled int trap
@@ -1819,7 +1826,7 @@ class SpmdTrainer(BaseTrainer):
                 # device -> a [num_devices] global, one count per shard)
                 "edges": jnp.sum(gd.in_degree).astype(jnp.int32)[None],
             }
-            return new_params, new_opt, loss, metrics
+            return new_params, new_opt, loss, nonfinite, metrics
 
         @partial(jax.shard_map, mesh=self.mesh, check_vma=check_vma,
                  in_specs=(P(), P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS),

@@ -17,7 +17,7 @@ zero-retrace asserted (wired into tools/preflight.sh).
 from roc_tpu.serve.engine import ServeEngine, bucket_sizes
 from roc_tpu.serve.loadgen import run_load
 from roc_tpu.serve.parity import max_ulp_diff
-from roc_tpu.serve.queue import MicrobatchQueue, ServeFuture
+from roc_tpu.serve.queue import MicrobatchQueue, Overloaded, ServeFuture
 
-__all__ = ["ServeEngine", "MicrobatchQueue", "ServeFuture", "bucket_sizes",
-           "max_ulp_diff", "run_load"]
+__all__ = ["ServeEngine", "MicrobatchQueue", "Overloaded", "ServeFuture",
+           "bucket_sizes", "max_ulp_diff", "run_load"]

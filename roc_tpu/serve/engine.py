@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from roc_tpu import obs
+from roc_tpu import fault, obs
 from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph.datasets import Dataset
 from roc_tpu.models.model import Model
@@ -114,7 +114,8 @@ class ServeEngine:
         if start_queue:
             self.queue = MicrobatchQueue(
                 self._serve_rows, batch=config.serve_batch,
-                wait_ms=config.serve_wait_ms, on_window=self._note_window)
+                wait_ms=config.serve_wait_ms, on_window=self._note_window,
+                queue_max=config.serve_queue_max)
 
     # -- the jitted query step --------------------------------------------
     def _build_serve_step(self):
@@ -149,8 +150,9 @@ class ServeEngine:
         """Serve one drained window: [k] node ids -> [k, C] logits.
         Chunks larger than the top bucket split across dispatches; each
         dispatch pays exactly one device round trip."""
-        ids = ids.reshape(-1)
-        if ids.size == 0:
+        fault.point("serve.fn")   # chaos site: a window-level serve
+        ids = ids.reshape(-1)     # failure resolves to its futures, the
+        if ids.size == 0:         # worker survives (tests pin this)
             return np.zeros((0, self.dataset.num_classes), np.float32)
         nn = self.bundle.num_nodes
         if ids.min() < 0 or ids.max() >= nn:
@@ -182,9 +184,10 @@ class ServeEngine:
         return out
 
     # -- request API ------------------------------------------------------
-    def submit(self, node_ids: Sequence[int]) -> ServeFuture:
+    def submit(self, node_ids: Sequence[int],
+               deadline_s: Optional[float] = None) -> ServeFuture:
         assert self.queue is not None, "engine built with start_queue=False"
-        return self.queue.submit(node_ids)
+        return self.queue.submit(node_ids, deadline_s=deadline_s)
 
     def query(self, node_ids: Sequence[int], timeout: float = 60.0):
         assert self.queue is not None, "engine built with start_queue=False"

@@ -28,12 +28,21 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 
+class Overloaded(RuntimeError):
+    """Typed shed signal: the serve queue refused or dropped a request
+    to keep its memory and latency bounded (depth cap hit at submit, or
+    a per-request deadline expired before its window drained).  Callers
+    distinguish this from a serving *failure* — the correct client
+    reaction is backoff/re-route, not a bug report."""
+
+
 class ServeFuture:
     """One request's pending result (numpy [k, C] logits)."""
 
-    __slots__ = ("ids", "_event", "_value", "_error", "t_submit", "t_done")
+    __slots__ = ("ids", "_event", "_value", "_error", "t_submit", "t_done",
+                 "deadline")
 
-    def __init__(self, ids):
+    def __init__(self, ids, deadline_s: Optional[float] = None):
         self.ids = ids
         self._event = threading.Event()
         self._value = None
@@ -42,6 +51,9 @@ class ServeFuture:
         # these are raw clock reads and not an obs.span
         self.t_submit = time.perf_counter()
         self.t_done = 0.0
+        # absolute drop-dead stamp on the same clock (None = no deadline)
+        self.deadline = None if deadline_s is None \
+            else self.t_submit + float(deadline_s)
 
     def _resolve(self, value=None, error=None):
         self._value, self._error = value, error
@@ -71,38 +83,58 @@ class MicrobatchQueue:
     one drained window; ``on_window(latencies)`` (optional) receives the
     window's per-request latencies after completion — the engine feeds
     its p99 EWMA watchdog from it.
+
+    Overload policy (``-serve-queue-max``): ``queue_max`` bounds the
+    number of pending requests; past the cap ``submit`` sheds with
+    :class:`Overloaded` instead of queueing without bound (0 =
+    unbounded, the pre-policy behavior).  A request may also carry its
+    own ``deadline_s`` — if its window drains after the deadline, the
+    future resolves with :class:`Overloaded` rather than burning a
+    device dispatch on an answer the caller already gave up on.
     """
 
     def __init__(self, serve_fn: Callable, batch: int = 64,
-                 wait_ms: float = 2.0, on_window: Optional[Callable] = None):
+                 wait_ms: float = 2.0, on_window: Optional[Callable] = None,
+                 queue_max: int = 0):
         assert batch >= 1, f"serve batch must be >= 1, got {batch}"
         assert wait_ms >= 0.0, f"serve wait must be >= 0 ms, got {wait_ms}"
+        assert queue_max >= 0, f"queue_max must be >= 0, got {queue_max}"
         self._serve_fn = serve_fn
         self._batch = int(batch)
         self._wait_s = float(wait_ms) / 1e3
         self._on_window = on_window
+        self._queue_max = int(queue_max)
         self._pending: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
         self.windows = 0
         self.served = 0
+        self.shed = 0      # submits refused at the depth cap
+        self.expired = 0   # requests dropped at drain (deadline passed)
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="roc-serve-queue")
         self._worker.start()
 
     # -- client side ------------------------------------------------------
-    def submit(self, node_ids: Sequence[int]) -> ServeFuture:
-        """Enqueue one request; returns a future resolving to [k, C]."""
+    def submit(self, node_ids: Sequence[int],
+               deadline_s: Optional[float] = None) -> ServeFuture:
+        """Enqueue one request; returns a future resolving to [k, C].
+        Raises :class:`Overloaded` when the queue is at its depth cap."""
         import numpy as np
         # request ingress: caller's id list -> host array.  Nothing device-
         # resident is touched here, but the serve host-sync lint rule has
         # no type information, so the conversion carries a waiver.
         ids = np.asarray(node_ids, np.int32).reshape(-1)  # roclint: allow(host-sync)
         assert ids.size >= 1, "empty query"
-        fut = ServeFuture(ids)
+        fut = ServeFuture(ids, deadline_s=deadline_s)
         with self._cv:
             if self._closed:
                 raise RuntimeError("queue closed")
+            if self._queue_max and len(self._pending) >= self._queue_max:
+                self.shed += 1
+                raise Overloaded(
+                    f"serve queue at capacity ({self._queue_max} pending "
+                    f"requests); shedding — retry with backoff")
             self._pending.append(fut)
             self._cv.notify()
         return fut
@@ -112,10 +144,23 @@ class MicrobatchQueue:
         return self.submit(node_ids).result(timeout)
 
     def close(self):
+        """Graceful drain: the worker finishes whatever is already
+        queued (``_drain`` keeps handing out windows after close until
+        the deque is empty), then any future the worker could not serve
+        — it died, or the join timed out — resolves with an error.  No
+        caller is ever left to wait out its own result timeout."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
         self._worker.join(timeout=5.0)
+        with self._cv:
+            leftover = list(self._pending)
+            self._pending.clear()
+        err = RuntimeError("serve queue closed before this request "
+                          "was served")
+        for f in leftover:
+            if not f.done():
+                f._resolve(error=err)
 
     # -- worker side ------------------------------------------------------
     def _drain(self) -> List[ServeFuture]:
@@ -141,11 +186,21 @@ class MicrobatchQueue:
                 if remaining <= 0:
                     break
                 self._cv.wait(timeout=remaining)
-            window, total = [], 0
+            window, expired, total = [], [], 0
+            now = time.perf_counter()
             while self._pending and total < self._batch:
-                window.append(self._pending.popleft())
-                total += window[-1].ids.size
-            return window
+                f = self._pending.popleft()
+                if f.deadline is not None and now > f.deadline:
+                    expired.append(f)   # resolved below, outside the lock
+                    continue
+                window.append(f)
+                total += f.ids.size
+        for f in expired:
+            self.expired += 1
+            f._resolve(error=Overloaded(
+                "request deadline expired before its serve window "
+                "drained; dropped unserved"))
+        return window
 
     def _run(self):
         import numpy as np

@@ -41,7 +41,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from roc_tpu import obs, ops
+from roc_tpu import fault, obs, ops
 from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph import shard_load
 from roc_tpu.graph.csr import Csr
@@ -163,6 +163,7 @@ class StreamTrainer(BaseTrainer):
         self._ring = PrefetchRing(cfg.stream_slots, self._fetch)
         self._keys = None
         self._grad_acc = None
+        self._last_gnorm = None
         self._xfer_bytes = 0
         self._scatter_futs = []
         self._scatter_s = 0.0
@@ -301,9 +302,13 @@ class StreamTrainer(BaseTrainer):
         opt = self.optimizer
 
         @jax.jit
-        def update(params, grads, opt_state, alpha):
+        def update(params, grads, opt_state, alpha, gscale):
             _retrace.note_trace("stream_update")
-            return opt.update(params, grads, opt_state, alpha)
+            # gscale is 1.0 on healthy steps (exact multiply); the chaos
+            # harness feeds NaN to exercise the non-finite guard
+            grads = jax.tree.map(lambda g: g * gscale, grads)
+            return fault.guarded_update(opt, params, grads, opt_state,
+                                        alpha)
 
         self._update = update
 
@@ -453,8 +458,9 @@ class StreamTrainer(BaseTrainer):
         self._xfer_bytes += sum(
             getattr(v, "nbytes", 0) for v in jax.tree_util.tree_leaves(a))
         with obs.span("stream_transfer", seg=k, shard=i):
-            a = jax.device_put(a)
-            jax.block_until_ready(a)
+            fault.point("stream.device_put")  # chaos site: a transient
+            a = jax.device_put(a)             # h2d failure is retried by
+            jax.block_until_ready(a)          # the ring's fetch wrapper
         return a
 
     def _sweep(self, phase, k, consume):
@@ -492,13 +498,24 @@ class StreamTrainer(BaseTrainer):
     def _scatter_async(self, seg, i, dt, down):
         """Queue shard i's cotangent scatter on the ring's worker so the
         device→host pull and ``np.add.at`` overlap the next shard's
-        compute.  The ``np.asarray`` calls inside the scatter helpers run
-        on the worker, so the consumer never blocks on the d2h copy."""
+        compute.  The d2h pulls (``np.asarray``) run on the worker under
+        a bounded retry — and ONLY the pulls: the mutating ``np.add.at``
+        / ``+=`` into the shared cotangent stores runs exactly once after
+        the pulls succeed, so a retried attempt can never double-count."""
         def work():
+            def _pull():
+                fault.point("stream.scatter")
+                dt_h = None if dt is None else np.asarray(dt)
+                down_h = {t: np.asarray(arr)
+                          for t, arr in (down or {}).items()}
+                return dt_h, down_h
             with obs.span("stream_scatter", seg=seg.index, shard=i) as sp:
-                if dt is not None:
-                    self._scatter_table(seg, i, dt)
-                self._scatter_own(seg, i, down)
+                dt_h, down_h = fault.retrying(
+                    "stream.scatter", _pull,
+                    retry_on=(OSError, RuntimeError))
+                if dt_h is not None:
+                    self._scatter_table(seg, i, dt_h)
+                self._scatter_own(seg, i, down_h)
             self._scatter_s += sp.dur_s
         self._scatter_futs.append(self._ring.submit(work))
 
@@ -545,8 +562,10 @@ class StreamTrainer(BaseTrainer):
                 self._drain_scatters()
                 self._sweep("bwd", k, self._consume_bwd(k, loss_parts))
             self._drain_scatters()
-            self.params, self.opt_state = self._update(
-                self.params, self._grad_acc, self.opt_state, alpha)
+            (self.params, self.opt_state, self._last_nonfinite,
+             self._last_gnorm) = self._update(
+                self.params, self._grad_acc, self.opt_state, alpha,
+                fault.nan_scale())
             loss = jnp.sum(jnp.stack(loss_parts))
         self._note_epoch_stats(sp.dur_s)
         return loss

@@ -24,7 +24,7 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, Hashable, Tuple
 
-from roc_tpu import obs
+from roc_tpu import fault, obs
 
 __all__ = ["PrefetchRing"]
 
@@ -47,8 +47,18 @@ class PrefetchRing:
     # -- worker side --------------------------------------------------------
 
     def _run(self, item: Hashable) -> Any:
+        # Retried (roc_tpu/fault): a fetch re-reads host stores and
+        # re-stages — idempotent, so a transient device_put / host-read
+        # failure costs one backoff instead of killing the epoch when it
+        # surfaces later through wait().  RuntimeError covers the jax
+        # transfer layer's transient failures; InjectedFault is OSError.
+        def _attempt():
+            fault.point("ring.fetch.slow")
+            fault.point("ring.fetch")
+            return self._fetch_fn(item)
         with obs.span("stream_prefetch", item=str(item)) as sp:
-            out = self._fetch_fn(item)
+            out = fault.retrying("ring.fetch", _attempt,
+                                 retry_on=(OSError, RuntimeError))
         self.busy_s += sp.dur_s
         return out
 

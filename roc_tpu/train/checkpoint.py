@@ -2,18 +2,36 @@
 weights there live only in GPU framebuffers and every run starts from Glorot
 init).  Plain .npz of the flattened param/optimizer pytrees plus host-side
 training state; no external deps, works for multi-MB GNN weights.
+
+Crash consistency (roc_tpu/fault): the save writes a temp file (retried —
+a transient ENOSPC/EIO must not kill a multi-hour run), fsyncs data and
+directory entry before/after the rename (`fault.fsync_replace`), and
+stamps a CRC32 of the payload arrays into the meta record.  `load`
+verifies the CRC and raises :class:`CheckpointError` with a clear message
+on any torn/corrupt file instead of an opaque zipfile traceback.  The
+`ckpt.kill_tmp` / `ckpt.kill_rename` injection sites simulate a kill -9
+on either side of the rename; the resume tests pin that both leave a
+loadable checkpoint behind (the old one, or the new one — never garbage).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Any, Dict, Tuple
 
 import jax
 import numpy as np
 
+from roc_tpu import fault
+
 _FORMAT_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file that cannot be trusted (corrupt, truncated, or
+    from an incompatible format version)."""
 
 
 def _flatten(tree) -> Dict[str, np.ndarray]:
@@ -28,30 +46,76 @@ def _unflatten(tree_like, arrays: Dict[str, np.ndarray]):
     return jax.tree.unflatten(treedef, new)
 
 
+def _payload_crc(arrays: Dict[str, np.ndarray]) -> int:
+    """CRC32 over every payload array (sorted key order), covering key,
+    dtype, shape, and bytes — the integrity stamp `load` verifies."""
+    crc = 0
+    for k in sorted(arrays):
+        a = np.ascontiguousarray(arrays[k])
+        crc = zlib.crc32(f"{k}:{a.dtype.str}:{a.shape}".encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
 def save(path: str, params, opt_state, epoch: int, alpha: float,
          extra: Dict[str, Any] | None = None) -> None:
-    """Atomic save (write tmp + rename) of params + optimizer + host state."""
-    meta = {"version": _FORMAT_VERSION, "epoch": epoch, "alpha": alpha,
-            "extra": extra or {}}
+    """Durable atomic save: retried tmp write, then fsync(file) +
+    rename + fsync(dir), with a payload CRC32 in the meta record."""
     payload = {f"p_{k}": v for k, v in _flatten(params).items()}
     payload.update({f"o_{k}": v for k, v in _flatten(opt_state).items()})
+    meta = {"version": _FORMAT_VERSION, "epoch": epoch, "alpha": alpha,
+            "extra": extra or {}, "crc32": _payload_crc(payload)}
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-    os.replace(tmp, path)
+
+    def _write():
+        fault.point("ckpt.write")
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+    fault.retrying("ckpt.write", _write)
+    fault.point("ckpt.kill_tmp")      # crash window A: tmp on disk, target
+    fault.fsync_replace(tmp, path)    # untouched — old checkpoint survives
+    fault.point("ckpt.kill_rename")   # crash window B: new one is complete
+
+
+def _read_verified(path: str) -> Tuple[Dict[str, Any],
+                                       Dict[str, np.ndarray]]:
+    """Load + integrity-check an .npz checkpoint; CheckpointError with a
+    clear message on anything torn, corrupt, or version-skewed."""
+    try:
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+        if "meta" not in arrays:
+            raise ValueError("missing meta record")
+        meta = json.loads(bytes(arrays.pop("meta")).decode())
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise CheckpointError(
+            f"corrupt or truncated checkpoint {path!r} "
+            f"({type(e).__name__}: {e}); the durable-save protocol never "
+            f"produces this — restore from an older checkpoint") from e
+    if meta.get("version") != _FORMAT_VERSION:
+        raise CheckpointError(
+            f"checkpoint {path!r} has format version "
+            f"{meta.get('version')!r}, this build reads "
+            f"{_FORMAT_VERSION}")
+    want = meta.get("crc32")
+    if want is not None and _payload_crc(arrays) != want:
+        raise CheckpointError(
+            f"checkpoint {path!r} failed its CRC32 integrity check — "
+            f"payload bytes do not match the stamp written at save time "
+            f"(torn write or bit rot); restore from an older checkpoint")
+    return meta, arrays
 
 
 def load(path: str, params_like, opt_state_like
          ) -> Tuple[Any, Any, int, float, Dict[str, Any]]:
     """Restore into the same pytree structure as `params_like`/`opt_state_like`."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        assert meta["version"] == _FORMAT_VERSION, (
-            f"checkpoint version {meta['version']} != {_FORMAT_VERSION}")
-        p = {k[2:]: z[k] for k in z.files if k.startswith("p_")}
-        o = {k[2:]: z[k] for k in z.files if k.startswith("o_")}
+    meta, arrays = _read_verified(path)
+    p = {k[2:]: v for k, v in arrays.items() if k.startswith("p_")}
+    o = {k[2:]: v for k, v in arrays.items() if k.startswith("o_")}
     params = _unflatten(params_like, p)
     opt_state = _unflatten(opt_state_like, o)
     return params, opt_state, meta["epoch"], meta["alpha"], meta["extra"]
@@ -59,11 +123,9 @@ def load(path: str, params_like, opt_state_like
 
 def load_params(path: str, params_like) -> Any:
     """Params-only restore (frozen/serving paths — roc_tpu/train/frozen.py):
-    skips the optimizer arrays entirely, so an inference process never
-    materializes 2x the weights it will never step."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        assert meta["version"] == _FORMAT_VERSION, (
-            f"checkpoint version {meta['version']} != {_FORMAT_VERSION}")
-        p = {k[2:]: z[k] for k in z.files if k.startswith("p_")}
+    only the param arrays are kept/unflattened.  (The CRC verification
+    does stream every payload byte once — integrity beats the transient
+    read; only the params stay resident.)"""
+    meta, arrays = _read_verified(path)
+    p = {k[2:]: v for k, v in arrays.items() if k.startswith("p_")}
     return _unflatten(params_like, p)

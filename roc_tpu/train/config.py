@@ -204,6 +204,16 @@ class Config:
                                   # waiting to fill before draining — the
                                   # latency half of the batch/wait knob
                                   # pair; 0 drains after every request
+    serve_queue_max: int = 4096   # serve overload policy: max pending
+                                  # requests before submit() sheds with a
+                                  # typed Overloaded error (bounded queue
+                                  # memory under overload); 0 = unbounded
+    fault: str = ""               # chaos harness spec (roc_tpu/fault):
+                                  # seeded deterministic fault injection
+                                  # at named sites, e.g.
+                                  # "seed=3,ring.fetch=2,lux.read@0.1,
+                                  # retries=0".  Empty = disarmed (every
+                                  # fault.point is a no-op)
 
     def __post_init__(self):
         # ROC_BALANCE* env overrides so driverless entry points (bench.py,
@@ -304,6 +314,26 @@ class Config:
         if self.serve_wait_ms < 0:
             raise SystemExit(f"serve_wait_ms={self.serve_wait_ms} must be "
                              ">= 0 (0 drains after every request)")
+        try:
+            if "ROC_SERVE_QUEUE_MAX" in env:
+                self.serve_queue_max = int(env["ROC_SERVE_QUEUE_MAX"])
+        except ValueError:
+            raise SystemExit("ROC_SERVE_QUEUE_MAX must be an integer")
+        if self.serve_queue_max < 0:
+            raise SystemExit(f"serve_queue_max={self.serve_queue_max} must "
+                             "be >= 0 (0 disables the depth cap)")
+        # ROC_FAULT mirrors -fault (the fault harness also reads the env
+        # directly at import so driverless entry points arm without a
+        # Config); validate the spec eagerly so a typo'd chaos leg dies
+        # at startup, not mid-run.
+        if env.get("ROC_FAULT"):
+            self.fault = env["ROC_FAULT"]
+        if self.fault:
+            from roc_tpu.fault import inject as _fault_inject
+            try:
+                _fault_inject.parse_spec(self.fault)
+            except ValueError as e:
+                raise SystemExit(f"bad -fault spec {self.fault!r}: {e}")
 
     def mem_budget_bytes(self) -> int:
         """-mem-budget in bytes (0 = unset; driver falls back to the
@@ -428,6 +458,12 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-serve-wait-ms", dest="serve_wait_ms", type=float,
                    default=2.0, help="max ms a serving window waits to "
                         "fill before draining (0 = drain per request)")
+    p.add_argument("-serve-queue-max", dest="serve_queue_max", type=int,
+                   default=4096, help="max pending serve requests before "
+                        "submits shed with Overloaded (0 = unbounded)")
+    p.add_argument("-fault", default="",
+                   help="chaos spec (roc_tpu/fault), e.g. "
+                        "'seed=3,ring.fetch=2,step.nan=1'; empty = off")
     ns = p.parse_args(argv)
     cfg = Config(**{f.name: getattr(ns, f.name) if f.name != "layers" else []
                     for f in dataclasses.fields(Config)})

@@ -17,11 +17,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 
 import jax
 import jax.numpy as jnp
 
-from roc_tpu import obs, ops
+from roc_tpu import fault, obs, ops
 from roc_tpu.analysis import retrace as _retrace
 from roc_tpu.graph.datasets import Dataset
 from roc_tpu.models.model import GraphCtx, Model
@@ -366,6 +367,13 @@ class TrainStats:
     peak_hbm_source: str = ""
 
 
+# Consecutive guarded-skip steps before the escalation ladder engages
+# (rung 1: drop to the two-pass unfused program; rung 2: restore from
+# the last durable checkpoint).  One bad batch skips silently; K in a
+# row means the run is not recovering on its own.
+NONFINITE_ESCALATE_AFTER = 3
+
+
 class BaseTrainer:
     """Shared epoch loop, LR decay, metrics cadence, checkpointing."""
 
@@ -378,6 +386,16 @@ class BaseTrainer:
         self.key = jax.random.PRNGKey(config.seed)
         self.epoch = 0
         self.dtype = jnp.bfloat16 if config.use_bf16 else jnp.float32
+        # fault harness: arm -fault specs that arrived via the flag (the
+        # ROC_FAULT env path armed at roc_tpu.fault import); host side of
+        # the in-graph non-finite guard + its escalation ladder
+        if config.fault and config.fault != fault.spec():
+            fault.configure(config.fault)
+        self._last_nonfinite = None
+        self._nf_streak = 0
+        self._nf_skips = 0
+        self._nf_stage = 0
+        self._stop_signal = None
         # Edge-sharded aggregation is a multi-device strategy; SpmdTrainer
         # resolves "auto" from measured partition skew during _setup.
         self._use_edge_shard = False
@@ -434,6 +452,9 @@ class BaseTrainer:
             except OSError:
                 jsonl = ""  # keep the in-memory registry; skip the file
         self._metrics = obs.MetricsRegistry(jsonl_path=jsonl)
+        # retry/injection events from the fault harness land in the same
+        # JSONL stream as the metrics records (detached in _obs_finish)
+        fault.attach(self._metrics.emit)
         # Calibration ledger -> this run's stream: every cost-model
         # prediction/measurement pair (plan steps, step time, peak HBM,
         # wire bytes, ...) lands next to the epoch records it describes.
@@ -553,6 +574,7 @@ class BaseTrainer:
         # the ledger outlives the run (process singleton); stop routing
         # its records into this run's stream
         obs.get_ledger().detach()
+        fault.detach()
         verdict = self.watchdog.verdict() if self.watchdog else "off"
         self._metrics.emit(
             "train", epochs=stats.epochs, total_s=round(stats.total_s, 6),
@@ -670,14 +692,74 @@ class BaseTrainer:
     def _run_step(self, step_key, alpha):
         out = self._train_step(
             self.params, self.opt_state, self.x, self.labels, self.mask,
-            self.gdata, step_key, alpha)
+            self.gdata, step_key, alpha, fault.nan_scale())
         if self.config.obs:
             # the in-graph metrics pytree rides the step outputs; stash it
             # device-side — _obs_epoch fetches once after the timed window
-            self.params, self.opt_state, loss, self._last_step_metrics = out
+            (self.params, self.opt_state, loss, self._last_nonfinite,
+             self._last_step_metrics) = out
         else:
-            self.params, self.opt_state, loss = out
+            (self.params, self.opt_state, loss,
+             self._last_nonfinite) = out
         return loss
+
+    # -- non-finite step guard, host side (roc_tpu/fault/guard.py) --------
+    def _check_nonfinite(self, epoch: int, print_fn) -> None:
+        """Read the step's in-graph skip flag (the epoch sync already
+        landed, so this device_get is a ready-scalar fetch, not a stall),
+        track the consecutive-skip streak, and walk the escalation ladder
+        when the guard alone stops recovering."""
+        if self._last_nonfinite is None:
+            return
+        if not bool(jax.device_get(self._last_nonfinite)):
+            self._nf_streak = 0
+            return
+        self._nf_streak += 1
+        self._nf_skips += 1
+        if self.watchdog is not None:
+            alert = self.watchdog.observe_nonfinite(epoch, self._nf_streak)
+            if alert is not None and self._metrics is not None:
+                self._metrics.emit("watchdog", **alert)
+        if self.config.verbose:
+            print_fn(f"# fault: non-finite loss/grads at epoch {epoch}; "
+                     f"update skipped (streak {self._nf_streak})")
+        if self._nf_streak >= NONFINITE_ESCALATE_AFTER:
+            self._escalate_nonfinite(epoch, print_fn)
+            self._nf_streak = 0
+
+    def _escalate_nonfinite(self, epoch: int, print_fn) -> None:
+        """K consecutive skipped steps.  Rung 1 — a run on the fused
+        megakernel path falls back to the two-pass unfused program and
+        rebuilds its steps (a kernel-level numeric bug can then no longer
+        poison every step).  Rung 2 — restore params/optimizer state from
+        the last durable checkpoint and keep going."""
+        cfg = self.config
+        if self._nf_stage == 0 and cfg.megafuse:
+            self._nf_stage = 1
+            fault.emit_event("nonfinite_escalation", stage="unfuse",
+                             epoch=int(epoch), streak=self._nf_streak)
+            print_fn(f"# fault: {self._nf_streak} consecutive non-finite "
+                     f"steps — disabling -megafuse (two-pass fallback) and "
+                     f"rebuilding the train step")
+            cfg.megafuse = False
+            keep = self.params, self.opt_state, self.epoch
+            self._setup()
+            self.params, self.opt_state, self.epoch = keep
+            return
+        self._nf_stage = 2
+        path = cfg.checkpoint_path
+        if path and os.path.exists(path):
+            fault.emit_event("nonfinite_escalation", stage="restore",
+                             epoch=int(epoch), streak=self._nf_streak)
+            print_fn(f"# fault: non-finite streak persists — restoring "
+                     f"from checkpoint {path}")
+            self.restore(path)
+        else:
+            fault.emit_event("nonfinite_escalation", stage="no_checkpoint",
+                             epoch=int(epoch), streak=self._nf_streak)
+            print_fn("# fault: non-finite streak persists and no "
+                     "checkpoint is available; continuing with skipped "
+                     "updates")
 
     def evaluate(self) -> ops.PerfMetrics:
         return self._eval_step(self.params, self.x, self.labels, self.mask,
@@ -711,6 +793,22 @@ class BaseTrainer:
         rebalance_events = []
         peak_hbm = []
         peak_src = ""
+        # Graceful-shutdown contract: SIGTERM/SIGINT only raise a flag;
+        # the loop finishes the in-flight epoch, writes a final durable
+        # checkpoint (the end-of-train save below), and exits cleanly.
+        # Installable only on the main thread — elsewhere run unguarded.
+        self._stop_signal = None
+
+        def _on_stop(signum, frame):
+            del frame
+            self._stop_signal = signum
+
+        installed = {}
+        try:
+            for s in (signal.SIGTERM, signal.SIGINT):
+                installed[s] = signal.signal(s, _on_stop)
+        except ValueError:
+            installed = {}
         with obs.span("train", epochs=cfg.num_epochs) as sp_train:
             try:
                 for epoch in range(start, start + cfg.num_epochs):
@@ -732,6 +830,7 @@ class BaseTrainer:
                             epoch, self.epoch_times[-1], peak_hbm=hbm,
                             peak_hbm_source=peak_src)
                     self._obs_epoch(epoch, sp_epoch.dur_s, loss, print_fn)
+                    self._check_nonfinite(epoch, print_fn)
                     if tracing and epoch + 1 == prof_stop:
                         device_sync(self.params)
                         jax.profiler.stop_trace()
@@ -765,12 +864,20 @@ class BaseTrainer:
                     # sees a reshard's (cache-missing) rebuild as the
                     # violation it is.
                     _retrace.epoch_boundary(done)
+                    if self._stop_signal is not None:
+                        name = signal.Signals(self._stop_signal).name
+                        print_fn(f"# fault: {name} received — epoch "
+                                 f"{epoch} finished; checkpointing and "
+                                 f"exiting cleanly")
+                        break
             finally:
                 # profiler-session leak fix: a crash mid-window must still
                 # close the trace, or the next start_trace in the process
                 # dies on the leaked session
                 if tracing:
                     jax.profiler.stop_trace()
+                for s, h in installed.items():
+                    signal.signal(s, h)
             device_sync(self.params)
         dt = sp_train.dur_s
         if cfg.checkpoint_path:
@@ -792,8 +899,26 @@ class BaseTrainer:
         return stats
 
     # -- checkpoint/resume (absent from the reference, SURVEY.md §5.4) ----
+    def _resume_extra(self):
+        """JSON-able host-side state a crash-consistent resume needs
+        beyond the param/optimizer arrays: the base PRNG key (so resumed
+        dropout streams match the unkilled run exactly), the balancer's
+        current cut, and the watchdog's learned EWMAs (a resumed run
+        keeps its regression baselines instead of re-warming)."""
+        import numpy as np
+        extra = {"rng_key": [int(v) for v in np.asarray(self.key).ravel()],
+                 "nonfinite_skips": int(self._nf_skips)}
+        if self.watchdog is not None:
+            extra["watchdog"] = self.watchdog.state_dict()
+        bounds = getattr(getattr(self, "part", None), "bounds", None)
+        if bounds is not None:
+            extra["balance_bounds"] = [int(b) for b in np.asarray(bounds)]
+        return extra
+
     def save_checkpoint(self, path: str, extra=None):
         from roc_tpu.train import checkpoint
+        if extra is None:
+            extra = self._resume_extra()
         # Params/opt state are replicated: every process holds the same
         # values, so only process 0 writes (P identical writers on shared
         # storage would be redundant work + a last-writer race); the barrier
@@ -807,9 +932,24 @@ class BaseTrainer:
             multihost_utils.sync_global_devices("roc_tpu_ckpt_saved")
 
     def restore(self, path: str):
+        import numpy as np
         from roc_tpu.train import checkpoint
-        self.params, self.opt_state, self.epoch, self.optimizer.alpha, _ = \
-            checkpoint.load(path, self.params, self.opt_state)
+        (self.params, self.opt_state, self.epoch, self.optimizer.alpha,
+         extra) = checkpoint.load(path, self.params, self.opt_state)
+        if not extra:
+            return
+        if "rng_key" in extra:
+            self.key = jnp.asarray(extra["rng_key"], jnp.uint32)
+        if self.watchdog is not None and "watchdog" in extra:
+            self.watchdog.load_state(extra["watchdog"])
+        self._nf_skips = int(extra.get("nonfinite_skips", 0))
+        bounds = extra.get("balance_bounds")
+        cur = getattr(getattr(self, "part", None), "bounds", None)
+        if bounds is not None and cur is not None and hasattr(self, "reshard") \
+                and not np.array_equal(np.asarray(bounds), np.asarray(cur)):
+            # re-apply the balancer's last committed cut so the resumed
+            # partition matches the one the checkpointed run trained on
+            self.reshard(np.asarray(bounds, np.int64))
 
 
 class Trainer(BaseTrainer):
@@ -839,25 +979,31 @@ class Trainer(BaseTrainer):
             from roc_tpu.obs import channel as obs_channel
 
         @jax.jit
-        def train_step(params, opt_state, x, labels, mask, gdata, key, alpha):
+        def train_step(params, opt_state, x, labels, mask, gdata, key, alpha,
+                       gscale):
             _retrace.note_trace("train_step")
             gctx = make_gctx(gdata, n, mega)
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, x, labels, mask, gctx, key=key, train=True)
-            params, opt_state = self.optimizer.update(
-                params, grads, opt_state, alpha)
+            # gscale is 1.0 on every healthy step (an exact multiply —
+            # bitwise no-op); the chaos harness feeds NaN to exercise the
+            # guard.  Same shape/dtype either way: no retrace.
+            loss = loss * gscale
+            grads = jax.tree.map(lambda g: g * gscale, grads)
+            params, opt_state, nonfinite, gnorm = fault.guarded_update(
+                self.optimizer, params, grads, opt_state, alpha, loss=loss)
             if not obs_on:
-                return params, opt_state, loss
+                return params, opt_state, loss, nonfinite
             # in-graph metrics channel (obs/channel.py): pure functions of
             # values already in the program — no syncs, no collectives
             metrics = {
-                "grad_norm": obs_channel.global_norm(grads),
+                "grad_norm": gnorm,
                 "param_norm": obs_channel.global_norm(params),
                 # single device: nothing crosses a wire
                 "wire_bytes": jnp.float32(0.0),
                 "edges": jnp.sum(gdata.in_degree).astype(jnp.int32)[None],
             }
-            return params, opt_state, loss, metrics
+            return params, opt_state, loss, nonfinite, metrics
 
         @jax.jit
         def eval_step(params, x, labels, mask, gdata):

@@ -179,9 +179,12 @@ def load_store(path: str = ""):
 
 
 def save_store(path: str, doc: dict) -> None:
-    """Deterministic, atomic write: sorted keys + fixed separators so the
-    same sweep produces byte-identical files (the CI determinism pin),
-    tmp + os.replace so readers never see a torn document."""
+    """Deterministic, durable atomic write: sorted keys + fixed
+    separators so the same sweep produces byte-identical files (the CI
+    determinism pin), tmp + fsync + rename (fault.fsync_replace) so
+    readers never see a torn document and a kill never leaves an
+    unflushed one."""
+    from roc_tpu.fault import fsync_replace
     problems = validate_store(doc)
     if problems:
         raise ValueError(f"refusing to write invalid tuned store: "
@@ -191,7 +194,7 @@ def save_store(path: str, doc: dict) -> None:
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
         f.write("\n")
-    os.replace(tmp, path)
+    fsync_replace(tmp, path)
     _CACHE.pop(path, None)
 
 

@@ -146,6 +146,12 @@ _POSITIVE = {
         "def f(buf, t):\n"
         "    buf.record_event('probe', predicted_time_s=t)\n",
     ],
+    "silent-swallow": [
+        # error dropped on the floor: no log, no counter, no comment
+        "try:\n    sync()\nexcept OSError:\n    pass\n",
+        "for p in paths:\n    try:\n        load(p)\n"
+        "    except Exception:\n        continue\n",
+    ],
     "hand-rolled-geometry": [
         "from roc_tpu.ops.pallas.binned import Geometry\n"
         "g = Geometry(512, 2048, 128, 512, 4096)\n",
@@ -219,6 +225,21 @@ def test_lint_waiver():
     # a waiver for a different rule does not silence it
     src2 = src.replace("allow(host-sync)", "allow(unkeyed-rand)")
     assert len(lint.lint_source(src2)) == 1
+
+
+def test_lint_silent_swallow_waiver_and_exemptions():
+    """A handler that actually does something is clean; a waiver with a
+    rationale silences the rule; test files are exempt (fixtures
+    legitimately swallow expected errors)."""
+    assert lint.lint_source(
+        "try:\n    sync()\nexcept OSError as e:\n    log(e)\n") == []
+    waived = ("try:\n    sync()\nexcept OSError:\n"
+              "    pass  # roclint: allow(silent-swallow) — best-effort\n")
+    assert lint.lint_source(waived) == []
+    bad = "try:\n    sync()\nexcept OSError:\n    pass\n"
+    assert any(f.rule == "silent-swallow" for f in lint.lint_source(bad))
+    assert lint.lint_source(bad, "tests" + os.sep + "test_x.py") == []
+    assert lint.lint_source(bad, "test_x.py") == []
 
 
 def test_lint_zero_false_positives_on_tree():

@@ -305,7 +305,8 @@ def test_gat_edge_shard_plan_equals_single_and_scatter_free():
     # (matches " scatter(" but not "reduce-scatter(" / "select-and-scatter(")
     txt = te._train_step.lower(
         te.params, te.opt_state, te.x, te.labels, te.mask, te.gdata,
-        jax.random.key(0), jnp.float32(0.01)).compile().as_text()
+        jax.random.key(0), jnp.float32(0.01),
+        np.float32(1.0)).compile().as_text()
     hits = re.findall(r"(?<![\w-])scatter\(", txt)
     assert not hits, f"compiled step still contains {len(hits)} scatter ops"
 

@@ -212,7 +212,7 @@ def test_forced_matmul_identical_to_auto(monkeypatch):
     def step_text(tr):
         return tr._train_step.lower(
             tr.params, tr.opt_state, tr.x, tr.labels, tr.mask, tr.gdata,
-            jax.random.key(0), jnp.float32(0.01)).as_text()
+            jax.random.key(0), jnp.float32(0.01), np.float32(1.0)).as_text()
 
     hf = hashlib.sha1(step_text(tf).encode()).hexdigest()
     ha = hashlib.sha1(step_text(ta).encode()).hexdigest()

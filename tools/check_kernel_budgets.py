@@ -313,6 +313,7 @@ def main(argv=None) -> int:
                 if "measured" in prev:
                     table["measured"] = prev["measured"]
             except ValueError:
+                # roclint: allow(silent-swallow) — rewrite below replaces it wholesale
                 pass
         with open(BUDGETS_PATH, "w", encoding="utf-8") as f:
             json.dump(table, f, indent=1, sort_keys=True)

@@ -9,7 +9,8 @@
 #   2. products-shape A/B (matmul vs auto-binned vs +reorder)
 #   3. fp32-exact + GAT + overcommit benches
 #   4. TPU-gated kernel tests
-#   5. out-of-core streaming A/B (streamed vs in-core + overlap fraction)
+#   5. out-of-core streaming A/B, serving bench, fault drill (SIGTERM ->
+#      resume parity; seeded chaos twin on the streamed path)
 #   6. group-count / constant / sparse-preset sweeps
 # Each step is timeout-guarded so a wedged compile can't eat the window.
 # Usage:  bash tools/hw_revalidate.sh [start-step]  (from repo root)
@@ -236,6 +237,35 @@ done
 # bench artifact; excluded from vs_baseline / the canonical persist)
 ROC_BENCH_SERVE=1 ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
     | tail -2 | tee -a "$LOG"
+
+note "5c. fault drill on the chip (roc_tpu/fault): three legs."
+note "    (i) SIGTERM mid-run — the trainer must finish the epoch, write"
+note "    the checkpoint, and exit cleanly; (ii) -resume from that"
+note "    checkpoint completes and the final loss matches the"
+note "    uninterrupted reference leg; (iii) a seeded chaos leg (retried"
+note "    ring fetch + lux read, one injected NaN step) on the streamed"
+note "    path must finish with a finite loss within 1e-3 of its own"
+note "    fault-free twin.  Chaos legs NEVER feed perf baselines — their"
+note "    epoch times include injected sleeps and retries."
+CKPT=/tmp/roc_fault_drill.npz
+DRILL="python -m roc_tpu -dataset reddit-small -layers 602-64-41 -e 12 -v"
+rm -f "$CKPT"
+timeout 900 $DRILL -ckpt "$CKPT" -ckpt-every 2 > /tmp/roc_drill_a.log 2>&1 &
+DRILL_PID=$!
+sleep 45; kill -TERM "$DRILL_PID" 2>/dev/null
+wait "$DRILL_PID"
+tail -2 /tmp/roc_drill_a.log | tee -a "$LOG"
+grep -q "exiting cleanly" /tmp/roc_drill_a.log \
+    || note "   drill note: no clean-exit line (run may have finished first)"
+[ -f "$CKPT" ] || note "   drill RED: SIGTERM leg left no checkpoint"
+timeout 900 $DRILL -ckpt "$CKPT" -resume 2>&1 | tail -2 | tee -a "$LOG"
+timeout 900 $DRILL 2>&1 | tail -2 | tee -a "$LOG"   # uninterrupted reference
+# chaos twin pair on the streamed path (same seed; compare final losses)
+STREAMED="python -m roc_tpu -dataset reddit-small -layers 602-64-41 \
+    -e 10 -parts 2 -stream -stream-slots 2 -v"
+timeout 900 $STREAMED 2>&1 | tail -2 | tee -a "$LOG"
+ROC_FAULT="seed=5,ring.fetch=2,lux.read=1,step.nan=1" timeout 900 \
+    $STREAMED 2>&1 | tail -3 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 6 ]; then

@@ -110,6 +110,17 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python tools/serve_bench.py --selftest || {
     echo "preflight: serve bench selftest RED" >&2; exit 1; }
 
+# Fault-harness gate: the chaos machinery itself must be provably live —
+# seeded spec determinism, retry recovery/exhaustion/kill-switch, the
+# fsync-rename durability helper, the jitted non-finite skip, and a
+# seeded NaN-injection mini-train + serve-queue shed smoke.  Without
+# this, "the faults didn't fire" and "the faults fired and were
+# survived" are indistinguishable from a green run.
+echo "== fault selftest =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m roc_tpu.fault --selftest >/dev/null || {
+    echo "preflight: fault selftest RED" >&2; exit 1; }
+
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
