@@ -2,7 +2,7 @@
 
 Run by tools/preflight.sh so a broken chaos harness is caught before
 anyone trusts a green chaos run ("the faults didn't fire" and "the
-faults fired and were survived" look identical from the outside).  Five
+faults fired and were survived" look identical from the outside).  Six
 stages, all deterministic and CPU-cheap:
 
   1. spec      — parse/validation + seeded per-call determinism
@@ -11,6 +11,9 @@ stages, all deterministic and CPU-cheap:
   4. guard     — jitted non-finite skip keeps params bitwise
   5. chaos     — a seeded mini-train with an injected NaN step completes
                  with finite params, plus a serve-queue shed/drain smoke
+  6. delta     — delta-journal chaos: transient append faults retried,
+                 kill windows either side of the journal fsync and the
+                 replan swap replay to the same plan arrays on restart
 
 Exit 0 and print "fault selftest: OK" on success; any assertion failure
 exits nonzero with the stage name in the traceback.
@@ -171,13 +174,91 @@ def _stage_chaos():
     assert q.shed == 1
 
 
+def _stage_delta():
+    """Delta-journal chaos: every kill window either loses nothing (the
+    record never hit the WAL) or replays exactly (it did) — the exact
+    dichotomy the write-ahead discipline promises."""
+    import numpy as np
+    from roc_tpu.fault import inject
+    from roc_tpu.graph.csr import from_edges
+    from roc_tpu.serve.delta import DeltaManager
+    from roc_tpu.train.driver import dense_graph_data
+
+    rng = np.random.default_rng(5)
+    n = 64
+    # 200 edges: the single (block, bin) cell pads to 256, leaving
+    # headroom so the adds below patch in place instead of escalating
+    csr = from_edges(n, rng.integers(0, n, 200), rng.integers(0, n, 200))
+
+    def fresh(jpath):
+        holder = {"gd": dense_graph_data(csr, "binned", "exact")}
+        mgr = DeltaManager(lambda: holder["gd"],
+                           lambda g: holder.__setitem__("gd", g),
+                           threading.RLock(), n, journal_path=jpath)
+        return holder, mgr
+
+    def plan_bytes(holder):
+        gd = holder["gd"]
+        return (np.asarray(gd.plans.fwd.p1_srcl).tobytes()
+                + np.asarray(gd.plans.bwd.p1_srcl).tobytes())
+
+    with tempfile.TemporaryDirectory() as d:
+        jpath = os.path.join(d, "deltas.wal")
+        # transient append faults are retried, not surfaced
+        holder, mgr = fresh(jpath)
+        inject.configure("seed=2,delta.journal.append=1")
+        try:
+            r = mgr.apply(np.asarray([[1, 2], [3, 4]]), None)
+        finally:
+            inject.configure("")
+        assert r["mode"] == "applied" and r["applied_adds"] == 2
+        # kill before any record byte lands: the batch is LOST on
+        # restart (the journal promised nothing yet) — by design
+        inject.configure("seed=2,delta.journal.kill_record=1")
+        try:
+            mgr.apply(np.asarray([[5, 6]]), None)
+            raise AssertionError("kill_record did not crash")
+        except inject.SimulatedCrash:
+            pass  # roclint: allow(silent-swallow) — expected-failure fixture
+        finally:
+            inject.configure("")
+        holder2, mgr2 = fresh(jpath)
+        assert mgr2._seq == 1, "unwritten record survived the crash"
+        assert mgr2.counters["replayed"] == 1
+        # kill after the durable write, before the in-memory patch:
+        # restart replays the batch to the state the ack would have seen
+        inject.configure("seed=2,delta.journal.kill_ack=1")
+        try:
+            mgr2.apply(np.asarray([[5, 6]]), None)
+            raise AssertionError("kill_ack did not crash")
+        except inject.SimulatedCrash:
+            pass  # roclint: allow(silent-swallow) — expected-failure fixture
+        finally:
+            inject.configure("")
+        # a torn tail (power cut mid-frame) truncates on open, keeping
+        # every complete record
+        with open(jpath, "ab") as f:
+            f.write(b"\x40\x00\x00\x00torn")
+        holder3, mgr3 = fresh(jpath)
+        assert mgr3._seq == 2, "durably-written record was not replayed"
+        assert mgr3.journal.torn_bytes > 0, "torn tail not truncated"
+        # oracle: the same applies on a fault-free manager, bit-for-bit
+        oracle_h, oracle_m = fresh(os.path.join(d, "oracle.wal"))
+        oracle_m.apply(np.asarray([[1, 2], [3, 4]]), None)
+        oracle_m.apply(np.asarray([[5, 6]]), None)
+        assert plan_bytes(holder3) == plan_bytes(oracle_h), \
+            "replayed plan arrays differ from the fault-free run"
+        for m in (mgr, mgr2, mgr3, oracle_m):
+            m.close()
+
+
 def main(argv):
     if "--selftest" not in argv:
         print(__doc__.strip())
         return 0
     for name, fn in (("spec", _stage_spec), ("retry", _stage_retry),
                      ("durable", _stage_durable), ("guard", _stage_guard),
-                     ("chaos", _stage_chaos)):
+                     ("chaos", _stage_chaos), ("delta", _stage_delta)):
         fn()
         print(f"# fault selftest: {name} ok")
     print("fault selftest: OK")

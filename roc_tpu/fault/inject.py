@@ -29,7 +29,13 @@ chaos tests pin loss parity against a fault-free run.
 Registered sites (grep for ``fault.point``): ``lux.read``,
 ``ring.fetch``, ``ring.fetch.slow``, ``stream.device_put``,
 ``stream.scatter``, ``step.nan``, ``ckpt.write``, ``ckpt.kill_tmp``,
-``ckpt.kill_rename``, ``serve.fn``.
+``ckpt.kill_rename``, ``serve.fn``, and the dynamic-delta family
+(roc_tpu/serve/delta.py): ``delta.apply``, ``delta.journal.append``,
+``delta.journal.fsync``, ``delta.journal.kill_record``,
+``delta.journal.kill_fsync``, ``delta.journal.kill_ack``,
+``delta.replan.slow``, ``delta.swap.kill_pre``, ``delta.swap.kill_post``,
+``delta.ckpt.write``, ``delta.ckpt.kill_tmp``, ``delta.ckpt.kill_rename``,
+``delta.ckpt.kill_snap``.
 
 stdlib-only on purpose: ``graph/lux.py`` (numpy + stdlib) imports this.
 """

@@ -104,6 +104,39 @@ def from_edges(num_nodes: int, src: np.ndarray, dst: np.ndarray) -> Csr:
     return Csr(num_nodes, int(src.shape[0]), row_ptr, col_idx)
 
 
+def with_edge_delta(g: Csr, add: np.ndarray = None,
+                    retire: np.ndarray = None) -> Csr:
+    """Rebuild-from-scratch oracle for dynamic deltas (tests + the
+    serving replan path, roc_tpu/serve/delta.py): apply an [n, 2]
+    (src, dst) add list and a retire list to ``g`` and rebuild through
+    :func:`from_edges`.  Retires remove the LAST live instance of each
+    (src, dst) pair — the same most-recently-added-first rule the
+    incremental patchers use — so the oracle and the patched plans
+    describe the same multiset.  Raises KeyError on retiring an edge
+    with no live instance (the caller classifies no-ops)."""
+    src = g.col_idx.astype(np.int64).tolist()
+    dst = g.dst_idx.astype(np.int64).tolist()
+    alive = [True] * len(src)
+    refs: dict = {}
+    for gi, sd in enumerate(zip(src, dst)):
+        refs.setdefault(sd, []).append(gi)
+    if add is not None:
+        for s, d in np.asarray(add, np.int64).reshape(-1, 2).tolist():
+            refs.setdefault((s, d), []).append(len(src))
+            src.append(s)
+            dst.append(d)
+            alive.append(True)
+    if retire is not None:
+        for s, d in np.asarray(retire, np.int64).reshape(-1, 2).tolist():
+            stack = refs.get((s, d))
+            if not stack:
+                raise KeyError(f"retire of dead edge ({s}, {d})")
+            alive[stack.pop()] = False
+    live_s = np.asarray([s for s, a in zip(src, alive) if a], V_DTYPE)
+    live_d = np.asarray([d for d, a in zip(dst, alive) if a], V_DTYPE)
+    return from_edges(g.num_nodes, live_s, live_d)
+
+
 def add_self_edges(g: Csr) -> Csr:
     """Add one self-edge per vertex if not already present.
 

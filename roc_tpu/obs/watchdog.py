@@ -64,6 +64,9 @@ class PerfWatchdog:
         # serving p99-latency EWMA (serve engine runs only)
         self.serve_ewma: Optional[float] = None
         self.serve_observed = 0
+        # delta-apply latency EWMA (dynamic-graph serving runs only)
+        self.delta_ewma: Optional[float] = None
+        self.delta_observed = 0
         # per-cost-model measured/predicted ratio EWMAs (ledger feed)
         self.calibration_band = (float(calibration_band[0]),
                                  float(calibration_band[1]))
@@ -75,6 +78,7 @@ class PerfWatchdog:
     # -- checkpoint round trip (roc_tpu/fault crash-consistent resume) ----
     _STATE_KEYS = ("ewma", "observed", "seeded", "stall_ewma",
                    "stall_observed", "serve_ewma", "serve_observed",
+                   "delta_ewma", "delta_observed",
                    "calib_ewma", "calib_observed", "nonfinite_steps")
 
     def state_dict(self) -> dict:
@@ -166,6 +170,31 @@ class PerfWatchdog:
         self.serve_observed += 1
         return alert
 
+    def observe_delta(self, batch: int, apply_s: float) -> Optional[dict]:
+        """Feed one delta-apply wall time (serve/delta.py feeds every
+        applied batch; replay batches are excluded — restart replay is
+        bulk work, not a serving-path sample).  Alert when an apply
+        exceeds ``ratio`` x its own EWMA — a patch that suddenly re-cuts
+        far more cells, or journal fsync latency, shows up here before
+        it backs up the mutation path.  Observation 0 carries the
+        first device_put/allocation noise and never sets the baseline,
+        mirroring observe_serve."""
+        t = float(apply_s)
+        armed = self.delta_ewma is not None and \
+            self.delta_observed >= self.warmup
+        alert = None
+        if armed and t > self.ratio * self.delta_ewma:
+            alert = {"kind": "delta-apply", "batch": int(batch),
+                     "apply_s": t, "ewma_s": float(self.delta_ewma),
+                     "ratio": t / self.delta_ewma}
+            self.alerts.append(alert)
+            t = self.ratio * self.delta_ewma  # clamp, as observe_epoch
+        if self.delta_observed >= 1:
+            self.delta_ewma = t if self.delta_ewma is None else \
+                self.alpha * t + (1.0 - self.alpha) * self.delta_ewma
+        self.delta_observed += 1
+        return alert
+
     def observe_nonfinite(self, epoch: int,
                           consecutive: int) -> Optional[dict]:
         """Feed one skipped (non-finite loss/grad) step from the in-graph
@@ -227,8 +256,9 @@ class PerfWatchdog:
     def verdict(self) -> str:
         """"nonfinite" outranks everything (numerics beat perf), then
         "regressed" if any slow-epoch fired, then "straggler", then
-        "stream-stall", then "serve-latency", then "calibration-drift",
-        "ok" otherwise — stamped into bench artifacts."""
+        "stream-stall", then "serve-latency", then "delta-apply", then
+        "calibration-drift", "ok" otherwise — stamped into bench
+        artifacts."""
         kinds = {a["kind"] for a in self.alerts}
         if "nonfinite" in kinds:
             return "nonfinite"
@@ -240,6 +270,8 @@ class PerfWatchdog:
             return "stream-stall"
         if "serve-latency" in kinds:
             return "serve-latency"
+        if "delta-apply" in kinds:
+            return "delta-apply"
         if "calibration-drift" in kinds:
             return "calibration-drift"
         return "ok"

@@ -2951,3 +2951,261 @@ def pad_binned_plan(plan: BinnedPlan, C1: int, C2: int) -> BinnedPlan:
         p2_first=jnp.pad(plan.p2_first, ((0, 0), (0, d2))),
         num_rows=plan.num_rows, table_rows=plan.table_rows,
         bins_per_group=plan.bins_per_group, geom=plan.geom)
+
+
+# -- incremental cell re-cut (dynamic-graph deltas, roc_tpu/serve/delta) ----
+#
+# The builders above are whole-graph; serving-time edge churn must not
+# rebuild (minutes of host work at scale) or retrace (new buffers = new
+# jit cache entry).  The delta path instead re-cuts ONE (source-block x
+# destination-bin) cell at a time: a plan's cells are contiguous,
+# capacity-padded row ranges of p1_srcl / p2_dstl whose positions are a
+# pure function of the BUILD-TIME edge list and geometry, so rewriting a
+# cell's rows in place (live edges compacted first, pad values after)
+# reproduces the builder's semantics exactly while every other array —
+# p1_off / p1_blk / p1_dsrc / p1_ddst / p2_obi / p2_first — stays
+# untouched (they encode the cell LAYOUT, not the cell CONTENTS).
+# plan_cell_layout re-derives that layout with builder-identical
+# arithmetic; patch_plan_cells rewrites one cell into host copies of the
+# two content arrays, which the caller device_puts into the SAME padded
+# shapes (same treedef, same jit cache — zero retraces by construction).
+
+
+class CellOverflowError(Exception):
+    """An edge delta does not fit a cell's build-time slot padding (or
+    lands in a cell the plan never cut).  Not a failure: the caller's
+    escalation ladder answers with a full replan (roc_tpu/serve/delta)."""
+
+
+@dataclasses.dataclass
+class CellLayout:
+    """Per-cell row geometry of one built plan direction.
+
+    ``cell_ptr[i]:cell_ptr[i+1]`` indexes the flat row maps for cell i
+    (capacity rows, in in-cell order):
+      row_p1  row into the group's [C1*CH] phase-1 srcl rows
+      row_stg row into the group's [C2*CH2] staging rows
+      row_sec flat-schedule secondary-block addend (0 or sb; slot: 0)
+    ``pad_srcl`` is the builder's value for unwritten p1 rows (slot
+    schedule 0 — staged garbage masked at phase 2; flat -1 — exact-zero
+    one-hot row)."""
+    num_rows: int
+    table_rows: int
+    bins_per_group: int
+    geom: Geometry
+    G: int
+    C1: int
+    C2: int
+    num_bins: int
+    num_blocks: int
+    cell_blk: np.ndarray    # [ncell] int64 source block
+    cell_bin: np.ndarray    # [ncell] int64 GLOBAL destination bin
+    cell_cap: np.ndarray    # [ncell] int64 padded row capacity
+    cell_ptr: np.ndarray    # [ncell+1] int64 prefix into the row maps
+    row_p1: np.ndarray      # [sum(cap)] int64
+    row_stg: np.ndarray     # [sum(cap)] int64
+    row_sec: np.ndarray     # [sum(cap)] int64
+    pad_srcl: int
+
+    def __post_init__(self):
+        k = self.cell_blk * self.num_bins + self.cell_bin
+        self._korder = np.argsort(k)
+        self._ksorted = k[self._korder]
+
+    @property
+    def ncell(self) -> int:
+        return len(self.cell_blk)
+
+    def cells_of(self, src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+        """Cell index of each (src, dst) edge; -1 where the plan never
+        cut that (block, bin) cell (caller escalates to a replan)."""
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        q = (src // self.geom.sb) * self.num_bins + dst // self.geom.rb
+        pos = np.searchsorted(self._ksorted, q)
+        pos = np.minimum(pos, max(len(self._ksorted) - 1, 0))
+        out = np.full(len(q), -1, np.int64)
+        if len(self._ksorted):
+            hit = self._ksorted[pos] == q
+            out[hit] = self._korder[pos[hit]]
+        return out
+
+
+def plan_cell_layout(edge_src: np.ndarray, edge_dst: np.ndarray,
+                     num_rows: int, table_rows: int,
+                     geom: Geometry = None,
+                     group_row_target: int = _GROUP_ROW_TARGET
+                     ) -> CellLayout:
+    """Re-derive a built plan's per-cell row layout from its BUILD-TIME
+    edge list (the same arrays the plan was built from, in the same
+    order) with builder-identical arithmetic — every formula below
+    mirrors _build_binned_plan_numpy / _build_flat_plan_numpy, and the
+    delta manager verifies the claim by re-rendering the content arrays
+    from this layout and comparing them to the plan's (so native-builder
+    drift refuses the patch path instead of corrupting it)."""
+    geom = (geom or _default_geom()).check()
+    if geom.grt:
+        group_row_target = geom.grt
+    SB, CH, SLOT, RB, CH2 = geom[:5]                   # noqa: N806
+    edge_src = np.asarray(edge_src, np.int64)
+    edge_dst = np.asarray(edge_dst, np.int64)
+    E = edge_src.shape[0]
+    num_bins = max(-(-num_rows // RB), 1)
+    num_blocks = max(-(-table_rows // SB), 1)
+    bins_per_group = max(min(
+        num_bins,
+        int(group_row_target / max(E / num_bins, 1)),
+        _K2_CAP // num_blocks), 1)
+    G = -(-num_bins // bins_per_group)
+
+    bin_of = edge_dst // RB
+    blk_of = edge_src // SB
+    grp_of = bin_of // bins_per_group
+    order = np.lexsort((bin_of, blk_of, grp_of))
+    s_bin, s_blk = bin_of[order], blk_of[order]
+    cell_key = (grp_of[order] * num_blocks + s_blk) * num_bins + s_bin
+    uniq, cell_start, cell_cnt = np.unique(
+        cell_key, return_index=True, return_counts=True)
+    ncell = len(uniq)
+    cell_g = uniq // (num_bins * num_blocks)
+    cell_blk = (uniq // num_bins) % num_blocks
+    cell_gbin = uniq % num_bins
+    cell_lbin = cell_gbin - cell_g * bins_per_group
+    bin_idx = cell_g * bins_per_group + cell_lbin
+    gb_key = uniq // num_bins
+    gb_uniq, gb_inv = np.unique(gb_key, return_inverse=True)
+    gb_g = gb_uniq // num_blocks
+
+    if geom.flat:
+        U = geom.unit_rows                              # noqa: N806
+        UC, U2 = CH // U, CH2 // U                      # noqa: N806
+        cell_units = -(-cell_cnt // U)
+        cell_cap = cell_units * U
+        dense_bin_units = np.zeros(G * bins_per_group, np.int64)
+        np.add.at(dense_bin_units, bin_idx, cell_units)
+        dense_bin_chunks = np.maximum(-(-dense_bin_units // U2), 1)
+        C2 = int(max(int(dense_bin_chunks.reshape(                 # noqa
+            G, bins_per_group).sum(1).max(initial=0)), 1))
+        bin_g = np.repeat(np.arange(G), bins_per_group)
+        bin_chunk_base = _prefix_within_runs(dense_bin_chunks, bin_g)
+        bo = np.argsort(bin_idx, kind="stable")
+        cell_off_in_bin = np.zeros(ncell, np.int64)
+        cell_off_in_bin[bo] = _prefix_within_runs(cell_units[bo],
+                                                  bin_idx[bo])
+        cell_stg_unit = bin_chunk_base[bin_idx] * U2 + cell_off_in_bin
+
+        gb_units = np.zeros(len(gb_uniq), np.int64)
+        np.add.at(gb_units, gb_inv, cell_units)
+        c1_per_g, segs = _flat_pack(gb_g, gb_units, UC, G, segments=True)
+        C1 = int(_pad_to(max(int(c1_per_g.max(initial=0)), 1), 8))  # noqa
+        seg_stream, seg_chunk, seg_pos, seg_take = segs.T
+        seg_g = gb_g[seg_stream]
+        seg_blk = gb_uniq[seg_stream] % num_blocks
+        p1_blk = np.zeros((G, C1), np.int64)
+        opens = seg_pos == 0
+        p1_blk[seg_g[opens], seg_chunk[opens]] = seg_blk[opens]
+
+        total_units = int(cell_units.sum())
+        cell_unit_base = np.cumsum(cell_units) - cell_units
+        seg_start = np.cumsum(seg_take) - seg_take
+        in_seg = np.arange(total_units) - np.repeat(seg_start, seg_take)
+        unit_chunk = np.repeat(seg_chunk, seg_take)
+        unit_pos = np.repeat(seg_pos, seg_take) + in_seg
+
+        cell_ptr = np.concatenate([[0], np.cumsum(cell_cap)])
+        tot = int(cell_ptr[-1])
+        rc = np.repeat(np.arange(ncell), cell_cap)
+        ri = np.arange(tot) - np.repeat(cell_ptr[:-1], cell_cap)
+        uid = cell_unit_base[rc] + ri // U
+        row_p1 = unit_chunk[uid] * CH + unit_pos[uid] * U + ri % U
+        row_stg = cell_stg_unit[rc] * U + ri
+        row_sec = SB * (p1_blk[cell_g[rc], unit_chunk[uid]]
+                        != cell_blk[rc]).astype(np.int64)
+        pad_srcl = -1
+    else:
+        NSLOT, SLOT2 = geom.nslot, geom.slot2           # noqa: N806
+        cell_slots = -(-cell_cnt // SLOT)
+        cell_cap = cell_slots * SLOT
+        gb_slots = np.zeros(len(gb_uniq), np.int64)
+        np.add.at(gb_slots, gb_inv, cell_slots)
+        gb_chunks = -(-gb_slots // NSLOT)
+        c1_per_g = np.zeros(G, np.int64)
+        np.add.at(c1_per_g, gb_g, gb_chunks)
+        C1 = int(_pad_to(max(int(c1_per_g.max(initial=0)), 1), 8))  # noqa
+        gb_chunk_base = _prefix_within_runs(gb_chunks, gb_g)
+        cell_p1_slot = _prefix_within_runs(cell_slots, gb_key)
+
+        dense_bin_slots = np.zeros(G * bins_per_group, np.int64)
+        np.add.at(dense_bin_slots, bin_idx, cell_slots)
+        dense_bin_chunks = np.maximum(-(-dense_bin_slots // SLOT2), 1)
+        C2 = int(max(int(dense_bin_chunks.reshape(                  # noqa
+            G, bins_per_group).sum(1).max(initial=0)), 1))
+        bin_g = np.repeat(np.arange(G), bins_per_group)
+        bin_chunk_base = _prefix_within_runs(dense_bin_chunks, bin_g)
+        bo = np.argsort(bin_idx, kind="stable")
+        cell_off_in_bin = np.zeros(ncell, np.int64)
+        cell_off_in_bin[bo] = _prefix_within_runs(cell_slots[bo],
+                                                  bin_idx[bo])
+        cell_stg_slot = bin_chunk_base[bin_idx] * SLOT2 + cell_off_in_bin
+
+        cell_ptr = np.concatenate([[0], np.cumsum(cell_cap)])
+        tot = int(cell_ptr[-1])
+        rc = np.repeat(np.arange(ncell), cell_cap)
+        ri = np.arange(tot) - np.repeat(cell_ptr[:-1], cell_cap)
+        base_p1 = gb_chunk_base[gb_inv] * CH + cell_p1_slot * SLOT
+        row_p1 = base_p1[rc] + ri
+        row_stg = cell_stg_slot[rc] * SLOT + ri
+        row_sec = np.zeros(tot, np.int64)
+        pad_srcl = 0
+
+    del cell_start
+    return CellLayout(
+        num_rows=num_rows, table_rows=table_rows,
+        bins_per_group=bins_per_group, geom=geom, G=G, C1=C1, C2=C2,
+        num_bins=num_bins, num_blocks=num_blocks,
+        cell_blk=cell_blk, cell_bin=cell_gbin,
+        cell_cap=cell_cap.astype(np.int64), cell_ptr=cell_ptr,
+        row_p1=row_p1, row_stg=row_stg, row_sec=row_sec,
+        pad_srcl=pad_srcl)
+
+
+def empty_cell_arrays(layout: CellLayout):
+    """Pad-initialized host copies of the two content arrays — what the
+    builders start from before writing any edge (slot p1 rows 0, flat
+    -1; staging rows RB = phase-2 masked)."""
+    p1 = np.full((layout.G, layout.C1 * layout.geom.ch),
+                 layout.pad_srcl, np.int32)
+    p2 = np.full((layout.G, layout.C2 * layout.geom.ch2),
+                 layout.geom.rb, np.int32)
+    return p1, p2
+
+
+def patch_plan_cells(layout: CellLayout, p1_srcl: np.ndarray,
+                     p2_dstl: np.ndarray, ci: int,
+                     src: np.ndarray, dst: np.ndarray) -> None:
+    """Rewrite ONE cell of the host content arrays in place: the cell's
+    live edges (in global-order; values must land in this cell) occupy
+    its first len(src) rows, the rest revert to pad values.  Raises
+    CellOverflowError when the edges exceed the cell's build-time
+    capacity — the escalation ladder's trigger, never a partial write."""
+    lo, hi = int(layout.cell_ptr[ci]), int(layout.cell_ptr[ci + 1])
+    cap = hi - lo
+    n = len(src)
+    if n > cap:
+        raise CellOverflowError(
+            f"cell {ci} (blk={int(layout.cell_blk[ci])}, "
+            f"bin={int(layout.cell_bin[ci])}): {n} edges exceed the "
+            f"build-time capacity of {cap} rows")
+    g = int(layout.cell_bin[ci]) // layout.bins_per_group
+    blk = int(layout.cell_blk[ci])
+    bn = int(layout.cell_bin[ci])
+    p1v = np.full(cap, layout.pad_srcl, np.int32)
+    p2v = np.full(cap, layout.geom.rb, np.int32)
+    if n:
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        p1v[:n] = (src - blk * layout.geom.sb
+                   + layout.row_sec[lo:lo + n]).astype(np.int32)
+        p2v[:n] = (dst - bn * layout.geom.rb).astype(np.int32)
+    p1_srcl[g, layout.row_p1[lo:hi]] = p1v
+    p2_dstl[g, layout.row_stg[lo:hi]] = p2v

@@ -6,18 +6,25 @@ plan cache (ISSUE "serving" tentpole; design in docs/DESIGN.md §Serving).
   engine.py   ServeEngine — frozen params in device buffers, bucketed
               jitted serve_step over the training forward, cold start =
               plan-cache load + one trace (zero rebuilds, pinned)
+  delta.py    crash-consistent dynamic-graph deltas — write-ahead
+              journal, incremental binned-cell patching (zero retraces,
+              zero rebuilds), background-replan escalation ladder
   parity.py   max_ulp_diff — the ≤32-ULP served-vs-eval gate
   loadgen.py  open-loop QPS generator for benches and the smoke gate
 
 `python -m roc_tpu.serve --selftest` is the CPU end-to-end smoke:
 cold start from a warm plan cache, ~100 mixed-size queries, parity +
-zero-retrace asserted (wired into tools/preflight.sh).
+zero-retrace asserted, plus a delta leg (mixed add/retire churn, journal
+restart-replay parity) — wired into tools/preflight.sh.
 """
 
+from roc_tpu.serve.delta import (DeltaError, DeltaJournal,
+                                 DeltaJournalError, DeltaManager)
 from roc_tpu.serve.engine import ServeEngine, bucket_sizes
 from roc_tpu.serve.loadgen import run_load
 from roc_tpu.serve.parity import max_ulp_diff
 from roc_tpu.serve.queue import MicrobatchQueue, Overloaded, ServeFuture
 
 __all__ = ["ServeEngine", "MicrobatchQueue", "Overloaded", "ServeFuture",
-           "bucket_sizes", "max_ulp_diff", "run_load"]
+           "DeltaError", "DeltaJournal", "DeltaJournalError",
+           "DeltaManager", "bucket_sizes", "max_ulp_diff", "run_load"]

@@ -24,12 +24,20 @@ slot machinery (`config.stream`): each drained window sweeps the
 host-resident shards through the frozen padded device slots — the same
 rotation eval uses — and gathers the queried rows on the host.
 
-Dynamic-graph deltas are the follow-on, NOT implemented here: see
-`apply_delta` for the design note.
+Dynamic-graph deltas (``delta_journal=`` at construction): edge
+appends/retires between requests journal to a write-ahead log, re-cut
+only the touched binned cells host-side, and device_put into the SAME
+padded buffers — zero retraces, zero plan rebuilds; a restart replays
+the journal to the exact served state.  Plan swaps (both the per-batch
+patch install and the escalation ladder's full-replan swap) happen
+under ``_plan_lock``, which the serve worker holds for a whole window —
+queries never see a torn plan.  See roc_tpu/serve/delta.py and
+docs/DESIGN.md §Dynamic deltas.
 """
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Optional, Sequence
 
@@ -66,7 +74,8 @@ class ServeEngine:
 
     def __init__(self, config: Config, dataset: Dataset, model: Model,
                  checkpoint_path: Optional[str] = None,
-                 watchdog=None, start_queue: bool = True):
+                 watchdog=None, start_queue: bool = True,
+                 delta_journal: Optional[str] = None):
         from roc_tpu.ops.pallas import binned as _B
         self.config = config
         self.dataset = dataset
@@ -75,6 +84,10 @@ class ServeEngine:
         self.buckets = bucket_sizes(config.serve_batch)
         self._lat_buf: list = []
         self._p99_windows = 0
+        # Serve worker holds this for a whole window; delta installs and
+        # the replan swap take it — atomic swap at a window boundary.
+        self._plan_lock = threading.RLock()
+        self.deltas = None
         # The engine's own trace counter: note_trace("serve_step") fires
         # only while jax is tracing, so the guard's counts ARE the trace
         # count.  Never self-arms (tests arm their own); close() exits it.
@@ -85,6 +98,23 @@ class ServeEngine:
         with obs.span("serve_cold_start") as sp:
             self.bundle: FrozenBundle = load_frozen(
                 config, dataset, model, checkpoint_path)
+            # Delta enable BEFORE the first trace: the manager strips the
+            # fused step lists (a treedef change) and installs patched
+            # plan arrays; doing it here keeps the jit cache warm for
+            # every later patch (same shapes, same treedef).
+            if delta_journal is not None:
+                from roc_tpu.serve.delta import DeltaManager
+                if self.bundle.stream_trainer is not None:
+                    from roc_tpu.serve.delta import DeltaError
+                    raise DeltaError(
+                        "dynamic deltas require the in-core binned "
+                        "engine; the streamed executor reshards from "
+                        "host-resident edges instead")
+                self.deltas = DeltaManager(
+                    lambda: self.bundle.gdata, self._install_gdata,
+                    self._plan_lock, self.bundle.num_nodes,
+                    journal_path=delta_journal or None,
+                    watchdog=watchdog, verbose=config.verbose)
             self._build_serve_step()
             # one trace on the smallest bucket proves the program compiles
             # before the first request lands; warmup() traces the rest
@@ -116,6 +146,13 @@ class ServeEngine:
                 self._serve_rows, batch=config.serve_batch,
                 wait_ms=config.serve_wait_ms, on_window=self._note_window,
                 queue_max=config.serve_queue_max)
+
+    def _install_gdata(self, gdata) -> None:
+        """Swap the resident graph data (delta patch install / replan
+        swap).  Caller holds ``_plan_lock``; FrozenBundle passes gdata
+        as a jit arg per dispatch, so a same-treedef replacement hits
+        the existing compiled program."""
+        self.bundle.gdata = gdata
 
     # -- the jitted query step --------------------------------------------
     def _build_serve_step(self):
@@ -157,7 +194,8 @@ class ServeEngine:
         nn = self.bundle.num_nodes
         if ids.min() < 0 or ids.max() >= nn:
             raise IndexError(f"query ids must be in [0, {nn})")
-        with obs.span("serve_window", n=int(ids.size)) as sp:
+        with obs.span("serve_window", n=int(ids.size)) as sp, \
+                self._plan_lock:
             if self.bundle.stream_trainer is not None:
                 # out-of-core: one slot sweep per window, gather on host.
                 # This is the window's ONE sanctioned batch-boundary sync.
@@ -225,37 +263,73 @@ class ServeEngine:
 
     def stats(self) -> dict:
         q = self.queue
-        return {
+        out = {
             "cold_start": dict(self.cold_start_stats),
             "windows": q.windows if q else 0,
             "requests": q.served if q else 0,
             "traces": int(sum(self._guard.counts.values())),
         }
+        if self.deltas is not None:
+            out["deltas"] = self.deltas.stats()
+        return out
+
+    # -- dynamic deltas ---------------------------------------------------
+    def apply_delta(self, add_edges=None, retire_edges=None,
+                    wait_replan: bool = False) -> dict:
+        """Apply one dynamic-graph delta batch.  CONTRACT:
+
+        - ``add_edges`` / ``retire_edges`` are [n, 2] integer arrays of
+          (src, dst) node ids.  Out-of-range ids or a malformed shape
+          reject the WHOLE batch with :class:`~roc_tpu.serve.delta.
+          DeltaError`; a rejected batch is never journaled and never
+          partially applied.
+        - Validated batches are framed into the write-ahead journal
+          (CRC32, monotone seq, fsync) BEFORE any in-memory patch; a
+          restart replays the journal over the frozen artifacts to the
+          exact served state (requires ``delta_journal=<path>`` at
+          construction — ``delta_journal=""`` runs volatile and loses
+          deltas on restart, tests pin both behaviors).
+        - The patch re-cuts ONLY the touched (block, bin) cells and
+          device_puts into the SAME padded buffers: zero retraces, zero
+          plan rebuilds (both test-pinned).  Re-adding a live edge or
+          retiring a dead one is a counted no-op, warned once.
+        - On cell-capacity exhaustion the batch escalates: a background
+          full replan runs on the mutated graph while the OLD plan keeps
+          serving, then swaps atomically at a window boundary; pass
+          ``wait_replan=True`` to block until the swap lands.
+        - Concurrent with queries: installs and swaps happen under the
+          window-held plan lock.  Concurrent mutations serialize.
+
+        Returns the manager's result dict (seq, mode "applied" /
+        "noop" / "replanning", per-op counts, cells_patched).
+        """
+        if self.deltas is None:
+            from roc_tpu.serve.delta import DeltaError
+            raise DeltaError(
+                "engine was built without delta support; construct with "
+                "delta_journal=<path> (journaled) or delta_journal='' "
+                "(volatile) — enabling after warmup would retrace")
+        return self.deltas.apply(add_edges, retire_edges,
+                                 wait_replan=wait_replan)
+
+    def delta_stats(self) -> dict:
+        return self.deltas.stats() if self.deltas is not None else {}
+
+    def checkpoint_deltas(self) -> None:
+        """Fold the delta journal into a verified snapshot + truncate
+        (one crash-consistent unit; see DeltaManager.checkpoint)."""
+        if self.deltas is not None:
+            self.deltas.checkpoint()
 
     # -- lifecycle --------------------------------------------------------
-    def apply_delta(self, add_edges=None, retire_edges=None):
-        """Dynamic-graph deltas — the follow-on, NOT implemented.
-
-        Design note (ROADMAP "dynamic-graph deltas"): appending/retiring
-        edges between requests must NOT replan or retrace.  The intended
-        mechanism reuses the balancer's frozen-shape reshard machinery:
-        a delta re-cuts only the affected binned cells (the plan's
-        (block, bin) groups are content-addressed, so an edge append
-        touches exactly the cells whose source block or dest bin it
-        lands in), patches those cells' slot/offset arrays host-side,
-        and device_put's the patched arrays into the SAME padded buffers
-        — same shapes, same jit cache, no plan-cache miss.  Retired
-        edges mask in place (the kernels already honor slot padding).
-        What is missing is the incremental cell re-cut (today's builders
-        are whole-graph) and a delta journal so a restart replays to the
-        served state; both land with the dynamic-graph PR.
-        """
-        raise NotImplementedError(
-            "dynamic-graph deltas are a designed follow-on (see docstring "
-            "+ docs/DESIGN.md §Serving); the serving engine is static-graph "
-            "for now")
-
     def close(self):
+        # Order matters (the close/in-flight-mutation race): first the
+        # delta manager — an apply that already hit the journal finishes
+        # its patch (finish-or-journal, never torn); then the queue
+        # drains, resolving every pending future against the final plan;
+        # the guard exits last.
+        if self.deltas is not None:
+            self.deltas.close()
         if self.queue is not None:
             self.queue.close()
         self._guard.__exit__(None, None, None)

@@ -79,6 +79,39 @@ def save(path: str, params, opt_state, epoch: int, alpha: float,
     fault.point("ckpt.kill_rename")   # crash window B: new one is complete
 
 
+def save_arrays(path: str, arrays: Dict[str, np.ndarray],
+                extra: Dict[str, Any] | None = None,
+                site: str = "ckpt") -> None:
+    """The durable-save protocol of :func:`save` for an arbitrary named
+    array dict (the delta-journal snapshot rides this, roc_tpu/serve/
+    delta.py): retried tmp write, CRC32 stamp in the meta record, fsync +
+    rename + dir fsync, with the same two kill windows exposed under
+    ``site`` ("<site>.write" / "<site>.kill_tmp" / "<site>.kill_rename")."""
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    meta = {"version": _FORMAT_VERSION, "epoch": -1, "alpha": 0.0,
+            "extra": extra or {}, "crc32": _payload_crc(payload)}
+    payload["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    tmp = path + ".tmp"
+
+    def _write():
+        fault.point(f"{site}.write")
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+    fault.retrying(f"{site}.write", _write)
+    fault.point(f"{site}.kill_tmp")
+    fault.fsync_replace(tmp, path)
+    fault.point(f"{site}.kill_rename")
+
+
+def load_arrays(path: str) -> Tuple[Dict[str, np.ndarray],
+                                    Dict[str, Any]]:
+    """Verified load of a :func:`save_arrays` file; CheckpointError on
+    anything torn, corrupt, or version-skewed."""
+    meta, arrays = _read_verified(path)
+    return arrays, meta.get("extra", {})
+
+
 def _read_verified(path: str) -> Tuple[Dict[str, Any],
                                        Dict[str, np.ndarray]]:
     """Load + integrity-check an .npz checkpoint; CheckpointError with a

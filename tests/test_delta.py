@@ -1,0 +1,663 @@
+"""Crash-consistent dynamic-graph deltas (roc_tpu/serve/delta.py).
+
+The contract under test mirrors the acceptance gates:
+
+- journal recovery matrix: torn tail truncated on open, CRC bit-rot
+  with valid frames after it -> typed DeltaJournalError, sequence gap
+  -> typed DeltaJournalError, kill windows on either side of the
+  journal fsync / the replan swap / the checkpoint replay to the exact
+  served state, and the same spec with the journal disabled
+  demonstrably loses the deltas;
+- parity: after >= 1000 mixed add/retire deltas the patched plans
+  produce BITWISE-identical aggregation to a from-scratch rebuild of
+  the mutated graph (integer-valued features — exactly representable
+  sums), and served engine logits match a rebuilt engine within the
+  32-ULP serving gate, with ZERO retraces and ZERO plan rebuilds on
+  the patch path (both pinned);
+- degradation ladder: a capacity-exhausting batch escalates to a
+  background full replan while the OLD plan keeps serving, the atomic
+  swap lands at a window boundary, counters exported;
+- validation-or-reject: malformed/out-of-range input raises DeltaError
+  and the journal records NOTHING; idempotent no-ops are counted and
+  warned once; close()/in-flight-mutation resolves every pending
+  future.
+"""
+
+import dataclasses
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from roc_tpu import obs
+from roc_tpu.fault import inject
+from roc_tpu.graph.csr import from_edges, with_edge_delta
+from roc_tpu.ops.aggregate import BinnedPlans
+from roc_tpu.ops.pallas import binned
+from roc_tpu.serve.delta import (DeltaError, DeltaJournal,
+                                 DeltaJournalError, DeltaManager,
+                                 _PlanPatcher, _strip_fused)
+from roc_tpu.train.driver import DenseGraphData
+
+
+# -- fixtures ---------------------------------------------------------------
+
+N_NODES = 96
+N_EDGES = 200     # the single (block, bin) cell pads to 256: headroom 56
+
+
+def _graph(seed=3, n=N_NODES, e=N_EDGES):
+    # base edges live on nodes 0..63 only: any edge touching a node
+    # >= 64 is deterministically fresh (adds) or dead (retires)
+    rng = np.random.default_rng(seed)
+    return from_edges(n, rng.integers(0, 64, e), rng.integers(0, 64, e))
+
+
+def _gdata(csr):
+    s = np.asarray(csr.col_idx, np.int64)
+    d = np.asarray(csr.dst_idx, np.int64)
+    n = csr.num_nodes
+    fwd = binned.build_binned_plan(s, d, n, n, tuned_ok=False)
+    bwd = binned.build_binned_plan(d, s, n, n, tuned_ok=False)
+    return DenseGraphData(
+        edge_src=jnp.asarray(s, jnp.int32),
+        edge_dst=jnp.asarray(d, jnp.int32),
+        in_degree=jnp.asarray(np.bincount(d, minlength=n), jnp.float32),
+        plans=BinnedPlans(fwd=fwd, bwd=bwd),
+        backend="binned", precision="exact")
+
+
+def _manager(csr, journal_path, **kw):
+    holder = {"gd": _gdata(csr)}
+    mgr = DeltaManager(lambda: holder["gd"],
+                       lambda g: holder.__setitem__("gd", g),
+                       threading.RLock(), csr.num_nodes,
+                       journal_path=journal_path, **kw)
+    return holder, mgr
+
+
+def _plan_bytes(holder):
+    gd = holder["gd"]
+    return b"".join(np.asarray(a).tobytes() for a in (
+        gd.plans.fwd.p1_srcl, gd.plans.fwd.p2_dstl,
+        gd.plans.bwd.p1_srcl, gd.plans.bwd.p2_dstl))
+
+
+def _agg(holder, x):
+    """One forward aggregation through the resident fwd plan."""
+    return np.asarray(binned.run_binned(x, holder["gd"].plans.fwd,
+                                        interpret=True))
+
+
+def _quiet_apply(mgr, *a, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        return mgr.apply(*a, **kw)
+
+
+# edges guaranteed fresh against _graph()'s 0..63 base
+_F1 = np.asarray([[70, 71], [72, 73]])
+_F2 = np.asarray([[80, 81]])
+
+
+# -- journal recovery matrix (pure I/O, no jax work) ------------------------
+
+def _rec(n):
+    return (np.arange(2 * n, dtype=np.int64).reshape(n, 2),
+            np.zeros((0, 2), np.int64))
+
+
+def test_journal_roundtrip_and_truncate(tmp_path):
+    p = str(tmp_path / "j.wal")
+    j = DeltaJournal(p)
+    for seq in (1, 2, 3):
+        j.append(seq, *_rec(seq))
+    j.close()
+    j2 = DeltaJournal(p)
+    assert [r[0] for r in j2.records] == [1, 2, 3]
+    assert j2.base_seq == 0 and j2.last_seq == 3
+    np.testing.assert_array_equal(j2.records[2][1], _rec(3)[0])
+    j2.truncate_to(3)
+    assert j2.records == [] and j2.base_seq == 3
+    j2.append(4, *_rec(1))
+    j2.close()
+    j3 = DeltaJournal(p)
+    assert j3.base_seq == 3 and [r[0] for r in j3.records] == [4]
+    j3.close()
+
+
+def test_journal_torn_tail_truncated(tmp_path):
+    p = str(tmp_path / "j.wal")
+    j = DeltaJournal(p)
+    j.append(1, *_rec(2))
+    size_good = os.path.getsize(p)
+    j.append(2, *_rec(2))
+    j.close()
+    # crash mid-frame: chop the final record short
+    with open(p, "r+b") as f:
+        f.truncate(size_good + 7)
+    j2 = DeltaJournal(p)
+    assert [r[0] for r in j2.records] == [1]
+    assert j2.torn_bytes == 7
+    assert os.path.getsize(p) == size_good     # tail gone from disk too
+    j2.close()
+
+
+def test_journal_bitrot_is_typed_error(tmp_path):
+    p = str(tmp_path / "j.wal")
+    j = DeltaJournal(p)
+    j.append(1, *_rec(2))
+    off_mid = os.path.getsize(p) - 10   # inside record 1's payload
+    j.append(2, *_rec(2))
+    j.close()
+    with open(p, "r+b") as f:
+        f.seek(off_mid)
+        b = f.read(1)
+        f.seek(off_mid)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(DeltaJournalError, match="bit rot"):
+        DeltaJournal(p)
+
+
+def test_journal_sequence_gap_is_typed_error(tmp_path):
+    p = str(tmp_path / "j.wal")
+    j = DeltaJournal(p)
+    j.append(1, *_rec(1))
+    j.append(3, *_rec(1))   # append frames what it is told; the scan
+    j.close()               # is where monotonicity is enforced
+    with pytest.raises(DeltaJournalError, match="sequence gap"):
+        DeltaJournal(p)
+
+
+def test_journal_bad_magic_and_header(tmp_path):
+    p = str(tmp_path / "j.wal")
+    DeltaJournal(p).close()
+    with open(p, "r+b") as f:
+        f.write(b"XXXX")
+    with pytest.raises(DeltaJournalError, match="bad magic"):
+        DeltaJournal(p)
+    with open(p, "wb") as f:
+        f.write(b"RDJ1\x00")
+    with pytest.raises(DeltaJournalError, match="header"):
+        DeltaJournal(p)
+
+
+# -- kill-window chaos: every site replays exactly --------------------------
+
+@pytest.mark.parametrize("site,recorded", [
+    ("delta.journal.kill_record", False),   # lost BEFORE the WAL: gone
+    ("delta.journal.kill_fsync", True),     # written + flushed: replays
+    ("delta.journal.kill_ack", True),       # durable, patch never ran
+])
+def test_journal_kill_windows_replay_exactly(tmp_path, site, recorded):
+    csr = _graph()
+    jp = str(tmp_path / "j.wal")
+    holder, mgr = _manager(csr, jp)
+    _quiet_apply(mgr, _F1, None)
+    inject.configure(f"seed=2,{site}=1")
+    try:
+        with pytest.raises(inject.SimulatedCrash):
+            _quiet_apply(mgr, _F2, None)
+    finally:
+        inject.configure("")
+    # restart over fresh frozen artifacts + the surviving journal
+    holder2, mgr2 = _manager(csr, jp)
+    # fault-free oracle applies exactly the batches the WAL promised
+    oh, om = _manager(csr, str(tmp_path / "oracle.wal"))
+    _quiet_apply(om, _F1, None)
+    if recorded:
+        _quiet_apply(om, _F2, None)
+    assert mgr2._seq == om._seq
+    assert mgr2.counters["replayed"] == (2 if recorded else 1)
+    assert _plan_bytes(holder2) == _plan_bytes(oh)
+    for m in (mgr2, om):
+        m.close()
+
+
+def _escalating_batch(k=80):
+    # unique fresh edges, enough to overflow the 56-row headroom
+    i = np.arange(k)
+    return np.stack([64 + i % 32, (7 * i + 1) % N_NODES], 1)
+
+
+@pytest.mark.parametrize("site", ["delta.swap.kill_pre",
+                                  "delta.swap.kill_post"])
+def test_swap_kill_windows_replay_exactly(tmp_path, site):
+    csr = _graph()
+    jp = str(tmp_path / "j.wal")
+    holder, mgr = _manager(csr, jp)
+    big = _escalating_batch()
+    inject.configure(f"seed=2,{site}=1")
+    try:
+        with pytest.raises(DeltaError) as ei:
+            _quiet_apply(mgr, big, None, wait_replan=True)
+        assert isinstance(ei.value.__cause__, inject.SimulatedCrash)
+    finally:
+        inject.configure("")
+    mgr.close()
+    # the escalating batch hit the WAL before the swap died: restart
+    # replays it through a (synchronous) replay replan to swapped state
+    holder2, mgr2 = _manager(csr, jp)
+    oh, om = _manager(csr, str(tmp_path / "oracle.wal"))
+    _quiet_apply(om, big, None, wait_replan=True)
+    assert mgr2._rebuilt and mgr2._seq == om._seq
+    assert _plan_bytes(holder2) == _plan_bytes(oh)
+    x = jnp.asarray(np.eye(N_NODES, 8, dtype=np.float32))
+    np.testing.assert_array_equal(_agg(holder2, x), _agg(oh, x))
+    for m in (mgr2, om):
+        m.close()
+
+
+@pytest.mark.parametrize("site", ["delta.ckpt.kill_tmp",
+                                  "delta.ckpt.kill_rename",
+                                  "delta.ckpt.kill_snap"])
+def test_checkpoint_kill_windows_consistent(tmp_path, site):
+    csr = _graph()
+    jp = str(tmp_path / "j.wal")
+    holder, mgr = _manager(csr, jp)
+    _quiet_apply(mgr, _F1, None)
+    _quiet_apply(mgr, None, np.asarray([[70, 71]]))
+    inject.configure(f"seed=2,{site}=1")
+    try:
+        with pytest.raises(inject.SimulatedCrash):
+            mgr.checkpoint()
+    finally:
+        inject.configure("")
+    # whichever side of the snapshot write / journal truncate the kill
+    # landed on, the restart reaches the exact pre-crash served state
+    holder2, mgr2 = _manager(csr, jp)
+    oh, om = _manager(csr, str(tmp_path / "oracle.wal"))
+    _quiet_apply(om, _F1, None)
+    _quiet_apply(om, None, np.asarray([[70, 71]]))
+    assert mgr2._seq == om._seq
+    assert _plan_bytes(holder2) == _plan_bytes(oh)
+    for m in (mgr2, om):
+        m.close()
+
+
+def test_journal_disabled_demonstrably_loses_deltas(tmp_path):
+    csr = _graph()
+    holder, mgr = _manager(csr, None)          # volatile: no WAL
+    _quiet_apply(mgr, _F1, None)
+    mutated = _plan_bytes(holder)
+    assert mgr.stats()["journal"] is None
+    mgr.close()
+    # "restart": a fresh volatile manager over the frozen artifacts has
+    # nothing to replay — the deltas are gone (back to the base plans)
+    holder2, mgr2 = _manager(csr, None)
+    assert _plan_bytes(holder2) != mutated
+    mgr2.close()
+
+
+# -- parity: >= 1000 mixed deltas, bitwise vs from-scratch rebuild ----------
+
+def test_thousand_delta_bitwise_parity_zero_rebuilds(tmp_path):
+    csr = _graph(seed=11)
+    holder, mgr = _manager(csr, str(tmp_path / "j.wal"))
+    n = csr.num_nodes
+    # independent oracle: a live-edge multiset under the same semantics
+    # (add is a no-op while any instance is live; retire drops one)
+    counts: dict = {}
+    for s, d in zip(csr.col_idx.tolist(), csr.dst_idx.tolist()):
+        counts[(s, d)] = counts.get((s, d), 0) + 1
+    rng = np.random.default_rng(4)
+    builds0 = binned.plan_build_count()
+    pending = []      # bounded in-flight set keeps cells inside headroom
+    ops = 0
+    while ops < 1000:
+        adds = rng.integers(0, n, (5, 2))
+        rets = None
+        if len(pending) >= 30:
+            rets = np.asarray([pending.pop(0) for _ in range(5)])
+        r = _quiet_apply(mgr, adds, rets)
+        assert r["mode"] in ("applied", "noop")
+        pending.extend(map(tuple, adds.tolist()))
+        for s, d in adds.tolist():
+            if counts.get((s, d), 0) == 0:
+                counts[(s, d)] = 1
+        if rets is not None:
+            for s, d in rets.tolist():
+                if counts.get((s, d), 0) > 0:
+                    counts[(s, d)] -= 1
+        ops += len(adds) + (0 if rets is None else len(rets))
+    st = mgr.stats()
+    assert st["replans"] == 0, "parity churn must stay on the patch path"
+    assert binned.plan_build_count() == builds0, "patch path rebuilt a plan"
+    assert st["applied_adds"] + st["applied_retires"] \
+        + st["noop_adds"] + st["noop_retires"] >= 1000
+    assert st["cells_patched"] > 0
+
+    # the manager's live store must equal the oracle multiset...
+    live_s, live_d = mgr._live_edges()
+    got = sorted(zip(live_s.tolist(), live_d.tolist()))
+    want = sorted(sd for sd, c in counts.items() for _ in range(c))
+    assert got == want
+    # ...and the patched plans must aggregate bitwise-identically to a
+    # from-scratch rebuild of that multiset (integer-valued features:
+    # the sums are exact, so a different edge order cannot differ)
+    oracle = from_edges(n, np.asarray([s for s, _ in want]),
+                        np.asarray([d for _, d in want]))
+    rebuilt = {"gd": _gdata(oracle)}
+    x = jnp.asarray(rng.integers(-8, 9, (n, 16)).astype(np.float32))
+    np.testing.assert_array_equal(_agg(holder, x), _agg(rebuilt, x))
+    got_b = np.asarray(binned.run_binned(x, holder["gd"].plans.bwd,
+                                         interpret=True))
+    want_b = np.asarray(binned.run_binned(x, rebuilt["gd"].plans.bwd,
+                                          interpret=True))
+    np.testing.assert_array_equal(got_b, want_b)
+    # in-degree repatched alongside the plans
+    np.testing.assert_array_equal(
+        np.asarray(holder["gd"].in_degree),
+        np.bincount(np.asarray(oracle.dst_idx),
+                    minlength=n).astype(np.float32))
+    mgr.close()
+
+
+# -- engine-level: served logits, zero retraces, degradation ladder ---------
+
+def _serve_engine(ds, delta_journal, start_queue=False):
+    from roc_tpu.models import build_model
+    from roc_tpu.serve import ServeEngine
+    from roc_tpu.train.config import Config
+    cfg = Config(layers=[ds.in_dim, 16, ds.num_classes], dropout_rate=0.0,
+                 eval_every=10**9, model="gcn", aggregate_backend="binned",
+                 serve_batch=8, serve_wait_ms=1.0,
+                 aggregate_precision="exact")
+    m = build_model("gcn", cfg.layers, cfg.dropout_rate, cfg.aggr)
+    return ServeEngine(cfg, ds, m, start_queue=start_queue,
+                       delta_journal=delta_journal)
+
+
+def test_engine_served_parity_after_churn_zero_retraces(tmp_path):
+    from roc_tpu.graph import datasets
+    from roc_tpu.serve import max_ulp_diff
+    ds = datasets.get("roc-audit", seed=1)
+    rng = np.random.default_rng(9)
+    n = ds.graph.num_nodes
+    eng = _serve_engine(ds, str(tmp_path / "j.wal"))
+    try:
+        eng.warmup()
+        base = eng._guard.snapshot()
+        builds0 = binned.plan_build_count()
+        pending = []
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            for _ in range(30):
+                adds = rng.integers(0, n, (2, 2))
+                rets = None
+                if len(pending) >= 8:
+                    rets = np.asarray([pending.pop(0), pending.pop(0)])
+                eng.apply_delta(adds, rets)
+                pending.extend(map(tuple, adds.tolist()))
+        st = eng.delta_stats()
+        assert st["replans"] == 0 and st["applied_adds"] > 0
+        served = eng._serve_rows(np.arange(n, dtype=np.int32))
+        eng._guard.assert_no_new_traces(base)       # ZERO retraces
+        assert binned.plan_build_count() == builds0  # ZERO plan rebuilds
+        # from-scratch oracle engine on the mutated graph, same params
+        live_s, live_d = eng.deltas._live_edges()
+        ds2 = dataclasses.replace(ds, graph=from_edges(n, live_s, live_d))
+        oracle = _serve_engine(ds2, None)
+        try:
+            oracle.bundle.params = eng.bundle.params
+            want = oracle._serve_rows(np.arange(n, dtype=np.int32))
+            assert max_ulp_diff(served, want) <= 32
+        finally:
+            oracle.close()
+    finally:
+        eng.close()
+
+
+def test_capacity_exhaustion_replan_while_serving(tmp_path):
+    from roc_tpu.graph import datasets
+    ds = datasets.get("roc-audit", seed=1)
+    n = ds.graph.num_nodes
+    eng = _serve_engine(ds, str(tmp_path / "j.wal"), start_queue=True)
+    try:
+        eng.warmup()
+        i = np.arange(300)
+        big = np.stack([i % n, (7 * i + 1) % n], 1)
+        # stall the background replan so the serving-through-it window
+        # is wide enough to assert against, not a race
+        inject.configure("seed=1,slow_ms=300,delta.replan.slow=1")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                r = eng.apply_delta(big, None)
+            assert r["mode"] == "replanning"
+            # the OLD plan keeps answering queries during the replan
+            out = eng.query(np.arange(8, dtype=np.int32), timeout=60.0)
+            assert out.shape == (8, ds.num_classes)
+        finally:
+            inject.configure("")
+        deadline = time.time() + 60.0
+        while eng.delta_stats()["swaps"] < 1:
+            assert time.time() < deadline, "replan swap never landed"
+            time.sleep(0.01)
+        st = eng.stats()["deltas"]          # counters exported
+        assert st["replans"] == 1 and st["swaps"] == 1 and st["rebuilt"]
+        # and the swapped plan serves the mutated graph
+        out = eng.query(np.arange(8, dtype=np.int32), timeout=60.0)
+        assert np.all(np.isfinite(out))
+    finally:
+        eng.close()
+
+
+def test_close_during_inflight_mutation_resolves_everything(tmp_path):
+    from roc_tpu.graph import datasets
+    ds = datasets.get("roc-audit", seed=1)
+    n = ds.graph.num_nodes
+    jp = str(tmp_path / "j.wal")
+    eng = _serve_engine(ds, jp, start_queue=True)
+    eng.warmup()
+    fut = eng.submit(np.arange(4, dtype=np.int32))
+    i = np.arange(300)
+    big = np.stack([i % n, (7 * i + 1) % n], 1)
+    inject.configure("seed=1,slow_ms=200,delta.replan.slow=1")
+    applied = threading.Event()
+
+    def mutate():
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            eng.apply_delta(big, None)      # escalates; replan stalled
+        applied.set()
+    t = threading.Thread(target=mutate)
+    t.start()
+    try:
+        assert applied.wait(30.0), "apply_delta never returned"
+        # close while the replan is still in flight: must finish the
+        # journaled batch (join the swap), drain the queue, resolve the
+        # pending future — and not deadlock
+        eng.close()
+    finally:
+        inject.configure("")
+        t.join(30.0)
+    assert fut.result(5.0).shape == (4, ds.num_classes)
+    st = eng.delta_stats()
+    assert st["swaps"] == 1, "close() did not finish the in-flight swap"
+    with pytest.raises(DeltaError, match="closed"):
+        eng.deltas.apply(np.asarray([[0, 1]]), None)
+    # restart replays to the state close() finished (snapshot + journal)
+    holder2, mgr2 = _manager(ds.graph, jp)
+    assert mgr2._seq == st["seq"] and mgr2._rebuilt
+    mgr2.close()
+
+
+def test_engine_without_deltas_raises_typed():
+    from roc_tpu.graph import datasets
+    ds = datasets.get("roc-audit", seed=1)
+    eng = _serve_engine(ds, None)
+    try:
+        with pytest.raises(DeltaError, match="delta_journal"):
+            eng.apply_delta(np.asarray([[0, 1]]), None)
+        assert eng.delta_stats() == {}
+    finally:
+        eng.close()
+
+
+# -- validation, idempotence, counters --------------------------------------
+
+def test_rejection_is_typed_and_never_journaled(tmp_path):
+    csr = _graph()
+    holder, mgr = _manager(csr, str(tmp_path / "j.wal"))
+    before = _plan_bytes(holder)
+    for bad_add in ([[0, N_NODES]], [[-1, 0]],
+                    np.asarray([[0.5, 1.5]], np.float64)):
+        with pytest.raises(DeltaError):
+            mgr.apply(np.asarray(bad_add), None)
+    assert mgr.journal.records == [] and mgr._seq == 0
+    assert _plan_bytes(holder) == before, "rejected batch touched a plan"
+    assert mgr.stats()["rejected"] == 3
+    mgr.close()
+
+
+def test_idempotent_noops_counted_and_warned_once(tmp_path):
+    csr = _graph()
+    holder, mgr = _manager(csr, str(tmp_path / "j.wal"))
+    live = (int(csr.col_idx[0]), int(csr.dst_idx[0]))
+    with pytest.warns(RuntimeWarning, match="idempotent"):
+        r = mgr.apply(np.asarray([live]), np.asarray([[90, 91]]))
+    assert r["mode"] == "noop"
+    assert r["noop_adds"] == 1 and r["noop_retires"] == 1
+    # pure-noop batches never consume a sequence number or a WAL record
+    assert mgr._seq == 0 and mgr.journal.records == []
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)   # warned ONCE
+        mgr.apply(np.asarray([live]), None)
+    st = mgr.stats()
+    assert st["noop_adds"] == 2 and st["noop_retires"] == 1
+    assert st["batches"] == 2
+    mgr.close()
+
+
+def test_retire_then_readd_and_within_batch_ordering(tmp_path):
+    csr = _graph()
+    holder, mgr = _manager(csr, str(tmp_path / "j.wal"))
+    e = (int(csr.col_idx[0]), int(csr.dst_idx[0]))
+    r = _quiet_apply(mgr, None, np.asarray([e]))
+    assert r["applied_retires"] == 1
+    r = _quiet_apply(mgr, np.asarray([e]), None)     # re-add after retire
+    assert r["applied_adds"] == 1
+    # one batch adding then retiring the same NEW edge: both effective
+    # (adds classify before retires), net zero live instances
+    r = _quiet_apply(mgr, np.asarray([[70, 71]]), np.asarray([[70, 71]]))
+    assert r["applied_adds"] == 1 and r["applied_retires"] == 1
+    live_s, live_d = mgr._live_edges()
+    assert not ((live_s == 70) & (live_d == 71)).any()
+    mgr.close()
+
+
+def test_with_edge_delta_oracle_helper():
+    csr = _graph()
+    g2 = with_edge_delta(csr, add=[[70, 71], [70, 71]], retire=[[70, 71]])
+    assert g2.num_edges == csr.num_edges + 1
+    with pytest.raises(KeyError):
+        with_edge_delta(csr, retire=[[95, 94]])
+
+
+# -- multi-cell layouts: both schedules, multiple groups --------------------
+
+@pytest.mark.parametrize("flat", [0, 1])
+def test_multigroup_cell_patch_bitwise(flat):
+    # grt=512 forces bins_per_group=1 -> one group per destination bin,
+    # exercising cross-group cell addressing in both schedules
+    geom = binned.Geometry(512, 2048, 128, 512, 4096, grt=512, flat=flat)
+    rng = np.random.default_rng(6)
+    n, e = 1600, 4000
+    s = rng.integers(0, n, e).astype(np.int64)
+    d = rng.integers(0, n, e).astype(np.int64)
+    order = np.argsort(d, kind="stable")
+    s, d = s[order], d[order]
+    plan = binned.build_binned_plan(s, d, n, n, geom=geom, tuned_ok=False)
+    assert plan.p1_blk.shape[0] > 1, "geometry lever failed to multi-group"
+    patcher = _PlanPatcher(_strip_fused(plan), s, d, swap=False)
+    patcher.verify(s.tolist(), d.tolist(), "test")    # layout == builder
+    lay = patcher.layout
+    # pick three distinct cells with build-time headroom and aim one add
+    # at each (an add may not overflow its cell's padded capacity)
+    cells = lay.cells_of(s, d)
+    occupancy = np.bincount(cells, minlength=lay.ncell)
+    roomy = np.nonzero(lay.cell_cap - occupancy >= 4)[0][:3]
+    assert len(roomy) == 3
+    store_s, store_d = s.tolist(), d.tolist()
+    gi0 = len(store_s)
+    for ci in roomy:
+        store_s.append(int(lay.cell_blk[ci]) * geom.sb)
+        store_d.append(int(lay.cell_bin[ci]) * geom.rb)
+    rets = [0, 1000, 2000]          # global indices of base edges
+    touched = patcher.stage(store_s, store_d,
+                            list(range(gi0, gi0 + len(roomy))), rets)
+    assert touched is not None and len(touched) >= 3
+    patcher.commit(store_s, store_d, touched)
+    p1, p2 = patcher.device_arrays()
+    patched = dataclasses.replace(_strip_fused(plan),
+                                  p1_srcl=p1, p2_dstl=p2)
+    live = np.ones(len(store_s), bool)
+    live[rets] = False
+    x = rng.integers(-4, 5, (n, 8)).astype(np.float32)
+    got = np.asarray(binned.run_binned(jnp.asarray(x), patched,
+                                       interpret=True))
+    want = np.zeros((n, 8), np.float64)
+    ls = np.asarray(store_s)[live]
+    ld = np.asarray(store_d)[live]
+    np.add.at(want, ld, x.astype(np.float64)[ls])
+    np.testing.assert_array_equal(got, want.astype(np.float32))
+
+
+def test_cell_overflow_raises_before_any_write():
+    csr = _graph()
+    s = np.asarray(csr.col_idx, np.int64)
+    d = np.asarray(csr.dst_idx, np.int64)
+    lay = binned.plan_cell_layout(s, d, N_NODES, N_NODES)
+    p1, p2 = binned.empty_cell_arrays(lay)
+    cap = int(lay.cell_cap[0])
+    over = np.zeros(cap + 1, np.int64)
+    with pytest.raises(binned.CellOverflowError):
+        binned.patch_plan_cells(lay, p1, p2, 0, over, over)
+    ref1, ref2 = binned.empty_cell_arrays(lay)
+    np.testing.assert_array_equal(p1, ref1)   # nothing partially written
+    np.testing.assert_array_equal(p2, ref2)
+
+
+# -- observability ----------------------------------------------------------
+
+def test_watchdog_delta_ewma_and_verdict():
+    from roc_tpu.obs.watchdog import PerfWatchdog
+    wd = PerfWatchdog(ratio=2.0, warmup=2)
+    assert wd.observe_delta(0, 5.0) is None      # obs 0 never seeds
+    assert wd.delta_ewma is None
+    for i in range(1, 4):
+        assert wd.observe_delta(i, 0.010) is None
+    alert = wd.observe_delta(4, 0.500)
+    assert alert is not None and alert["kind"] == "delta-apply"
+    assert alert["ratio"] > 2.0
+    assert wd.verdict() == "delta-apply"
+    # serve-latency outranks delta-apply in the verdict ladder
+    wd.alerts.append({"kind": "serve-latency"})
+    assert wd.verdict() == "serve-latency"
+    state = wd.state_dict()
+    assert "delta_ewma" in state and "delta_observed" in state
+    wd2 = PerfWatchdog()
+    wd2.load_state(state)
+    assert wd2.delta_ewma == wd.delta_ewma
+
+
+def test_delta_counters_and_ledger_pair(tmp_path):
+    csr = _graph()
+    holder, mgr = _manager(csr, str(tmp_path / "j.wal"))
+    _quiet_apply(mgr, _F2, None)
+    st = mgr.stats()
+    assert st["batches"] == 1 and st["applied_adds"] == 1
+    assert st["seq"] == 1 and st["live_edges"] == N_EDGES + 1
+    assert st["cells_patched"] >= 2     # one fwd cell + one bwd cell
+    # every applied batch lands a joined delta-apply pair in the ledger
+    paired = [rec for kind, rec in obs.get_ledger().records
+              if kind == "measurement" and rec["model"] == "delta-apply"
+              and "ratio" in rec]
+    assert paired
+    mgr.close()

@@ -203,11 +203,15 @@ def test_query_rejects_out_of_range_ids():
         eng.close()
 
 
-def test_apply_delta_is_designed_followon():
+def test_apply_delta_requires_enable_at_construction():
+    # enabling deltas after warmup would change the plan treedef and
+    # retrace; an engine built without delta support must say so, not
+    # silently degrade (full delta coverage lives in tests/test_delta.py)
+    from roc_tpu.serve import DeltaError
     ds = datasets.get("roc-audit", seed=1)
     eng = _engine(ds)
     try:
-        with pytest.raises(NotImplementedError):
+        with pytest.raises(DeltaError, match="delta_journal"):
             eng.apply_delta(add_edges=[(0, 1)])
     finally:
         eng.close()
@@ -358,6 +362,8 @@ def _serve_payload(**over):
     d = {"metric": "serve_p50", "value": 0.002, "unit": "s",
          "p50_s": 0.002, "p99_s": 0.006, "qps_offered": 100.0,
          "cold_start_s": 0.8, "platform": "cpu",
+         "delta": {"apply_p50_s": 0.001, "apply_p99_s": 0.004,
+                   "batches": 40, "replans": 1},
          "measured_at": "2026-08-05T00:00:00Z"}
     d.update(over)
     return {k: v for k, v in d.items() if v is not None}
@@ -381,10 +387,14 @@ def test_perf_ledger_serve_artifact_malformed(tmp_path):
     pl = _perf_ledger_mod()
     root = str(tmp_path)
     with open(os.path.join(root, pl.SERVE_ARTIFACT), "w") as f:
-        json.dump(_serve_payload(p99_s=None, measured_at=None), f)
+        json.dump(_serve_payload(p99_s=None, measured_at=None,
+                                 delta={"apply_p50_s": 0.001}), f)
     errs = pl.check(root)
     assert any("BENCH_SERVE.json" in e and "p99_s" in e for e in errs)
     assert any("measured_at" in e for e in errs)
+    # the nested delta block is schema-gated too
+    assert any("delta.apply_p99_s" in e for e in errs)
+    assert any("delta.replans" in e for e in errs)
 
 
 # -- roclint: serve host-sync rule -----------------------------------------

@@ -266,6 +266,20 @@ STREAMED="python -m roc_tpu -dataset reddit-small -layers 602-64-41 \
 timeout 900 $STREAMED 2>&1 | tail -2 | tee -a "$LOG"
 ROC_FAULT="seed=5,ring.fetch=2,lux.read=1,step.nan=1" timeout 900 \
     $STREAMED 2>&1 | tail -3 | tee -a "$LOG"
+
+note "5d. on-device delta drill (roc_tpu/serve/delta): mixed add/retire"
+note "    churn on the real chip — the serve selftest's delta leg pins"
+note "    zero retraces + zero plan rebuilds + journal restart-replay"
+note "    parity, then the fault selftest's delta stage runs the kill-"
+note "    window matrix (lost-before-WAL vs replayed-after-WAL, torn"
+note "    tail truncated).  The bench's delta block records apply"
+note "    p50/p99 fault-free; chaos legs NEVER feed perf baselines."
+timeout 900 python -m roc_tpu.serve --selftest 2>&1 | tail -3 | tee -a "$LOG"
+timeout 600 python -m roc_tpu.fault --selftest 2>&1 | tail -2 | tee -a "$LOG"
+timeout 1200 env ROC_SERVE_BENCH_DATASET=reddit-small \
+    ROC_SERVE_BENCH_REQUESTS=200 ROC_SERVE_BENCH_QPS=50 \
+    ROC_SERVE_BENCH_DELTAS=100 \
+    python tools/serve_bench.py 2>&1 | tail -1 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 6 ]; then

@@ -98,6 +98,9 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m roc_tpu \
 # asserted), ~100 mixed-batch-size queries on the tiny CPU dataset with
 # served-vs-eval parity <= 32 ULPs and zero retraces after warmup — the
 # serving contracts, end-to-end in one process (roc_tpu/serve/__main__).
+# Includes the delta leg: journaled add/retire churn patched with zero
+# retraces / zero plan rebuilds, then a restart that replays the delta
+# journal to bitwise-identical served logits.
 echo "== serve smoke =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python -m roc_tpu.serve --selftest >/dev/null || {
@@ -112,9 +115,10 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 
 # Fault-harness gate: the chaos machinery itself must be provably live —
 # seeded spec determinism, retry recovery/exhaustion/kill-switch, the
-# fsync-rename durability helper, the jitted non-finite skip, and a
-# seeded NaN-injection mini-train + serve-queue shed smoke.  Without
-# this, "the faults didn't fire" and "the faults fired and were
+# fsync-rename durability helper, the jitted non-finite skip, a seeded
+# NaN-injection mini-train + serve-queue shed smoke, and the delta-
+# journal kill-window matrix (lost-before-WAL vs replayed-after-WAL).
+# Without this, "the faults didn't fire" and "the faults fired and were
 # survived" are indistinguishable from a green run.
 echo "== fault selftest =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \

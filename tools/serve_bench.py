@@ -8,6 +8,8 @@ a training-claim round row):
   {"metric": "serve_p50", "value": ..., "unit": "s",
    "p50_s": ..., "p99_s": ..., "qps_offered": ..., "qps_achieved": ...,
    "cold_start_s": ..., "plan_builds": ..., "platform": ...,
+   "delta": {"apply_p50_s": ..., "apply_p99_s": ..., "batches": ...,
+             "applied_adds": ..., "applied_retires": ..., "replans": ...},
    "measured_at": ...}
 
 The cold start reported is the WARM-cache cold start (the serving
@@ -22,9 +24,15 @@ so overload shows up in the tail instead of throttling the offer rate.
                                               # root, schema-validated via
                                               # perf_ledger.check (preflight)
 
+The delta block times `apply_delta` on a SEPARATE volatile delta-enabled
+engine (the serve-latency numbers stay pure static-graph; a delta-enabled
+engine runs the unfused two-pass plan).  Chaos is never armed here —
+bench numbers exclude fault legs, per the PR 14 convention.
+
 Knobs (env, matching bench.py's style): ROC_SERVE_BENCH_DATASET,
 ROC_SERVE_BENCH_REQUESTS, ROC_SERVE_BENCH_QPS, ROC_SERVE_BATCH,
-ROC_SERVE_WAIT_MS, ROC_SERVE_BENCH_CKPT (optional checkpoint to serve).
+ROC_SERVE_WAIT_MS, ROC_SERVE_BENCH_CKPT (optional checkpoint to serve),
+ROC_SERVE_BENCH_DELTAS (delta batches to time, default 40).
 """
 
 from __future__ import annotations
@@ -93,7 +101,56 @@ def run_bench(dataset: str, n_requests: int, qps: float,
             # pairing lives in the engine); mirrors bench.py's waiver
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),  # roclint: allow(unledgered-prediction)
         }
+    payload["delta"] = _bench_deltas(cfg, ds, model, ckpt)
     return payload
+
+
+def _bench_deltas(cfg, ds, model, ckpt: str) -> dict:
+    """Time apply_delta on a volatile delta-enabled engine: mixed
+    add/retire churn, p50/p99 of the per-batch apply wall."""
+    import warnings
+
+    import numpy as np
+
+    from roc_tpu.serve import ServeEngine
+
+    n_batches = _env("ROC_SERVE_BENCH_DELTAS", "40", int)
+    rng = np.random.default_rng(17)
+    n = ds.graph.num_nodes
+    times = []
+    # deltas exist only for the binned backend; pin it regardless of
+    # what the serve phase's auto-resolution picked
+    import dataclasses
+    cfg = dataclasses.replace(cfg, aggregate_backend="binned")
+    with ServeEngine(cfg, ds, model, checkpoint_path=ckpt or None,
+                     start_queue=False, delta_journal="") as eng:
+        eng.warmup()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            for _ in range(n_batches):
+                adds = rng.integers(0, n, (2, 2))
+                rets = None
+                if rng.random() < 0.25:
+                    k = int(rng.integers(0, len(eng.deltas._src)))
+                    rets = np.asarray([[eng.deltas._src[k],
+                                        eng.deltas._dst[k]]])
+                # apply latency is the artifact being measured; spans
+                # cannot time it (percentiles need the raw samples)
+                t0 = time.perf_counter()  # roclint: allow(raw-timing)
+                eng.apply_delta(adds, rets, wait_replan=True)
+                times.append(time.perf_counter() - t0)  # roclint: allow(raw-timing)
+        st = eng.delta_stats()
+    lat = sorted(times)
+    return {
+        "apply_p50_s": lat[len(lat) // 2],
+        "apply_p99_s": lat[min(int(0.99 * (len(lat) - 1)), len(lat) - 1)],
+        "batches": int(st["batches"]),
+        "applied_adds": int(st["applied_adds"]),
+        "applied_retires": int(st["applied_retires"]),
+        "noops": int(st["noop_adds"] + st["noop_retires"]),
+        "cells_patched": int(st["cells_patched"]),
+        "replans": int(st["replans"]),
+    }
 
 
 def write_artifact(payload: dict, root: str = ROOT) -> str:
@@ -116,14 +173,20 @@ def selftest() -> int:
     path = write_artifact(payload, root=tmp)
     assert payload["plan_builds"] == 0, (
         f"warm cold start rebuilt {payload['plan_builds']} plan(s)")
+    assert payload["delta"]["batches"] > 0 and \
+        payload["delta"]["apply_p50_s"] > 0, "delta block did not measure"
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import perf_ledger
     errs = perf_ledger.check(root=tmp)
     assert not errs, f"BENCH_SERVE.json failed the schema gate: {errs}"
+    dl = payload["delta"]
     print(f"# serve_bench selftest: OK — p50={payload['p50_s'] * 1e3:.2f}ms "
           f"p99={payload['p99_s'] * 1e3:.2f}ms at "
           f"{payload['qps_offered']} qps offered, warm cold start "
-          f"{payload['cold_start_s']:.3f}s, plan_builds=0 ({path})")
+          f"{payload['cold_start_s']:.3f}s, plan_builds=0; delta apply "
+          f"p50={dl['apply_p50_s'] * 1e3:.2f}ms "
+          f"p99={dl['apply_p99_s'] * 1e3:.2f}ms over {dl['batches']} "
+          f"batches, replans={dl['replans']} ({path})")
     return 0
 
 
