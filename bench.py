@@ -191,6 +191,14 @@ FUSION = "none"
 if os.environ.get("ROC_MEGAFUSE") == "1":
     FUSION = "mega" if os.environ.get("ROC_MEGA_BWD", "") == "0" \
         else "mega+bwd"
+    # ROC_FUSION_DEPTH != 1 (round 16, mirrors -fusion-depth): the
+    # cross-layer fusion-region planner is active — stamp the depth
+    # (0 = full-model regions).  xlayer legs inherit the mega artifact
+    # policy: excluded from vs_baseline and the canonical persist until
+    # a device window confirms (hw_revalidate step 4d's three legs).
+    _FDEPTH = os.environ.get("ROC_FUSION_DEPTH", "1")
+    if _FDEPTH != "1":
+        FUSION = f"xlayer-{int(_FDEPTH)}"
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -596,6 +604,19 @@ def run():
                 from roc_tpu.memory.estimator import mega_bwd_cotangent_drop
                 mem["mega_bwd_cotangent_drop_bytes"] = \
                     mega_bwd_cotangent_drop(trainer.model, est.rows)
+            elif FUSION.startswith("xlayer-"):
+                # cross-layer legs: the region planner's predicted
+                # train-step HBM claim, stamped so hw_revalidate step 4d
+                # can compare against hardware counters
+                from roc_tpu.models.model import mega_regions
+                from roc_tpu.ops.pallas import binned as B
+                regs = mega_regions(trainer.model,
+                                    int(FUSION.split("-", 1)[1]))
+                mem["xlayer_trainstep_hbm_bytes"] = sum(  # roclint: allow(unledgered-prediction)
+                    B.predicted_xlayer_trainstep_hbm_bytes(
+                        est.rows,
+                        r["members"][0]["linear"].attrs["out_dim"],
+                        len(r["members"])) for r in regs.values())
         if plan is not None and plan.any_offload():
             # bench legs must not claim host offload before the streaming
             # executor is the one running: an OFFLOAD verdict lowered by the

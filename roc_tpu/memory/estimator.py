@@ -114,7 +114,8 @@ def _op_forward_s(op, in_dim: int, out_dim: int, rows: int,
 
 def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
                    fixed_bytes: int = 0,
-                   megafuse: bool = False) -> ModelEstimate:
+                   megafuse: bool = False, fusion_depth: int = 1,
+                   halo_rows: int = 0) -> ModelEstimate:
     """Per-layer byte/recompute estimates for ``model`` at a per-device
     shard of ``rows`` node rows and ``edges`` edges.
 
@@ -131,12 +132,27 @@ def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
     as the proxy for the pre-scaled input the folded path materializes
     instead).  Those contribute zero to ``bytes_full``/``bytes_saved``
     and the DP plans over the fused layer's real residual set.
+
+    ``fusion_depth != 1`` (with megafuse) additionally applies the
+    round-16 fusion REGION's kept/dropped tuple: ``mega_regions`` names
+    the inter-layer boundary tensors the cross-layer grid keeps in VMEM
+    for shard-local rows.  Those are NOT free — the halo frontier's
+    rows still round-trip HBM between layers (parallel/halo.py exchange
+    contract) — so they are priced at ``halo_rows`` rows instead of the
+    full shard (zero on a single device, where every row is local).
     """
     fused_gone: set = set()
+    frontier_gone: set = set()
     if megafuse:
-        from roc_tpu.models.model import mega_matches
+        from roc_tpu.models.model import mega_matches, mega_regions
         for rec in mega_matches(model).values():
             fused_gone.update(rec["gone"])
+        if fusion_depth != 1:
+            for reg in mega_regions(model, fusion_depth).values():
+                # region-dropped minus per-layer-dropped = the inter-layer
+                # boundaries the region ALSO eliminates; halo rows survive
+                frontier_gone.update(
+                    t for t in reg["gone"] if t not in fused_gone)
     dims = _op_out_dims(model)
     per_layer: Dict[int, List] = {}
     for op in model.ops:
@@ -146,11 +162,18 @@ def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
     for idx in sorted(per_layer):
         full = saved = boundary = 0
         fwd = cheap = 0.0
+        saw_boundary = False
         for op in per_layer[idx]:
             in_dim = dims[op.inputs[0]]
             out_dim = dims[op.out]
-            out_bytes = 0 if op.out in fused_gone \
-                else rows * out_dim * itemsize
+            if op.out in fused_gone:
+                out_bytes = 0
+            elif op.out in frontier_gone:
+                # inter-layer boundary inside a fusion region: only the
+                # halo frontier's rows materialize (kept/dropped honesty)
+                out_bytes = halo_rows * out_dim * itemsize
+            else:
+                out_bytes = rows * out_dim * itemsize
             t = _op_forward_s(op, in_dim, out_dim, rows, edges)
             full += out_bytes
             fwd += t
@@ -160,7 +183,11 @@ def estimate_model(model, rows: int, edges: int, itemsize: int = 4,
                 cheap += t
             if op.attrs.get("ckpt_boundary"):
                 boundary = out_bytes
-        if boundary == 0 and per_layer[idx]:
+                saw_boundary = True
+        # fallback only when the layer has NO tagged boundary op: a tagged
+        # boundary that priced to 0 is a region-interior tensor the fused
+        # grid keeps in VMEM — re-pricing it full would undo the honesty
+        if not saw_boundary and per_layer[idx]:
             last = per_layer[idx][-1]
             boundary = rows * dims[last.out] * itemsize
         layers.append(LayerEstimate(
@@ -221,10 +248,21 @@ def estimate_for_trainer(trainer) -> ModelEstimate:
     itemsize = int(np.dtype(trainer.dtype).itemsize)
     fixed = fixed_bytes_for(trainer.model, rows, ds.features.shape[1],
                             ds.num_classes, edges, itemsize)
+    # halo frontier (round 16): rows other shards reference still
+    # round-trip HBM at fused region boundaries — the received halo
+    # block is [P*K] rows per device in halo-exchange mode; 0 on a
+    # single device / allgather mode (where the region drop is total)
+    halo = getattr(trainer, "halo", None)
+    halo_rows = 0
+    if halo is not None and part is not None:
+        halo_rows = int(part.num_parts) * int(halo.K)
     return estimate_model(trainer.model, rows, edges, itemsize=itemsize,
                           fixed_bytes=fixed,
                           megafuse=getattr(trainer.config, "megafuse",
-                                           False))
+                                           False),
+                          fusion_depth=getattr(trainer.config,
+                                               "fusion_depth", 1),
+                          halo_rows=halo_rows)
 
 
 # -- XLA cross-checks (analysis/hlo_audit.py lowering machinery) ----------

@@ -57,6 +57,18 @@ class GraphCtx(NamedTuple):
     # the unfused op sequence runs unchanged.  Default None keeps every
     # existing program byte-identical — the HLO budget audit pins that.
     fuse_linear: Optional[Callable] = None
+    # cross-layer fusion-region hook (round 16):
+    # (x, ws, activations, fold) -> out or None.
+    # When set AND fusion_depth != 1, `apply` offers each
+    # `mega_regions`-eligible multi-layer chain (the region's weight and
+    # activation tuples, head to tail) to it before the per-layer
+    # fuse_linear pass; a None return declines the whole region and the
+    # per-layer matches run unchanged — byte-identical to fusion_depth=1.
+    fuse_region: Optional[Callable] = None
+    # static region-length cap keying the step cache: 1 = off (default,
+    # byte-identical to pre-round-16 programs), 2 = chains of exactly two
+    # layers, 0 = unlimited ("full").
+    fusion_depth: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,6 +188,127 @@ def mega_matches(model: "Model") -> Dict[int, dict]:
                     "skip": tuple(skip), "fold": True,
                     "gone": (op.out, agg.out) + ((n2.out,)
                                                  if final is not n2 else ())}
+    return found
+
+
+def mega_regions(model: "Model", max_depth: int = 0,
+                 train: bool = False) -> Dict[int, dict]:
+    """Chain ``mega_matches`` records into multi-layer fusion regions
+    (round 16): aggregate→linear(→relu)→aggregate→linear…, keyed by the
+    FIRST member's head-op index (the same index `apply` dispatches on,
+    so a declined region falls through to that member's per-layer match
+    byte-identically).
+
+    A chain link exists when member l's ``final`` output reaches member
+    l+1's head op through identity interstitials only — each hop single-
+    consumer, and the only interstitial kind admitted is a dropout that
+    is the identity (rate == 0.0, or eval mode).  Eligibility beyond the
+    per-member ``mega_matches`` gates: every member aggregates with
+    ``sum`` (avg's divide-by-degree runs outside the kernel and would
+    break the in-VMEM hand-off), ``fold`` is uniform across members (the
+    kernel applies one boundary epilogue shape), and no member's
+    ``final`` output is the logits tensor — the classifier layer never
+    fuses into a region, because its output must exist in HBM for the
+    loss anyway, so fusing it saves nothing and would force the region
+    backward to start from a softmax cotangent the kernel cannot see.
+
+    ``max_depth`` is the static region-length cap from
+    ``GraphCtx.fusion_depth``: 1 disables chaining entirely (returns {}),
+    2 caps chains at two members, 0 means unlimited.  Chains are maximal
+    under the cap and greedy from the earliest head, so the partition of
+    matches into regions is deterministic — tools/preflight.sh pins the
+    region plan JSON byte-identical across runs.
+
+    Each record carries ``members`` (the ordered per-layer match
+    records), ``final`` (the last member's final node, whose output
+    tensor and ckpt tag the fused region takes over), ``skip`` (every op
+    index the region replaces except the dispatch head), ``fold``, and
+    ``gone`` — the members' per-layer ``gone`` tensors plus the interior
+    members' final outputs and interstitial outputs, i.e. exactly the
+    inter-layer boundaries that never materialize in HBM (the memory
+    estimator's kept/dropped input; the region INPUT and OUTPUT survive).
+    """
+    if max_depth == 1:
+        return {}
+    matches = mega_matches(model)
+    if not matches:
+        return {}
+    consumers: Dict[int, List[int]] = {}
+    for i, op in enumerate(model.ops):
+        for t in op.inputs:
+            consumers.setdefault(t, []).append(i)
+    logits_id = model.logits.id if model.logits is not None else -1
+
+    def eligible(m):
+        return (m["aggregate"].attrs.get("aggr") == "sum"
+                and m["final"].out != logits_id)
+
+    # next-link map: match head index -> (next head index, interstitial
+    # op indices, interstitial output tensor ids)
+    nxt: Dict[int, tuple] = {}
+    for i, m in matches.items():
+        if not eligible(m):
+            continue
+        tid, inter_ops, inter_outs = m["final"].out, [], []
+        while True:
+            cons = consumers.get(tid, [])
+            if len(cons) != 1:
+                break
+            ci = cons[0]
+            op = model.ops[ci]
+            if op.inputs[0] != tid:
+                break
+            if ci in matches and eligible(matches[ci]):
+                nxt[i] = (ci, tuple(inter_ops), tuple(inter_outs))
+                break
+            if op.kind == "dropout" and (op.attrs.get("rate") == 0.0
+                                         or not train):
+                inter_ops.append(ci)
+                inter_outs.append(op.out)
+                tid = op.out
+                continue
+            break
+
+    # greedy maximal chains in ascending head order: links only run
+    # forward in the (topologically ordered) op list, so by the time a
+    # head is visited its predecessor — if any — has been consumed, and
+    # a capped chain's tail starts its own region deterministically
+    preds: Dict[int, int] = {}
+    for i, (j, _, _) in nxt.items():
+        preds[j] = i
+    found: Dict[int, dict] = {}
+    used: set = set()
+    for h in sorted(set(nxt) | set(preds)):
+        if h in used:
+            continue
+        p = preds.get(h)
+        if p is not None and p not in used:
+            continue
+        fold = matches[h]["fold"]
+        chain, i = [h], h
+        while i in nxt and (max_depth == 0 or len(chain) < max_depth):
+            j, _, _ = nxt[i]
+            if j in used or matches[j]["fold"] != fold:
+                break
+            chain.append(j)
+            i = j
+        used.update(chain)
+        if len(chain) < 2:
+            continue
+        members = tuple(matches[k] for k in chain)
+        skip: List[int] = list(members[0]["skip"])
+        gone: List[int] = list(members[0]["gone"])
+        for k_prev, k in zip(chain, chain[1:]):
+            _, inter_ops, inter_outs = nxt[k_prev]
+            skip.extend(inter_ops)
+            gone.extend(inter_outs)
+            gone.append(matches[k_prev]["final"].out)
+            skip.append(k)
+            skip.extend(matches[k]["skip"])
+            gone.extend(matches[k]["gone"])
+        found[h] = {"members": members, "final": members[-1]["final"],
+                    "fold": fold, "skip": tuple(skip),
+                    "gone": tuple(dict.fromkeys(gone))}
     return found
 
 
@@ -326,11 +459,30 @@ class Model:
         the pre-planner ones, which the HLO budget audit pins."""
         vals: Dict[int, jnp.ndarray] = {0: x}
         matches = mega_matches(self) if gctx.fuse_linear is not None else {}
+        regions = (mega_regions(self, gctx.fusion_depth, train)
+                   if gctx.fuse_region is not None
+                   and gctx.fusion_depth != 1 else {})
         skipped: set = set()
         for idx, op in enumerate(self.ops):
             if idx in skipped:
                 continue
             a = vals[op.inputs[0]]
+            if idx in regions:
+                r = regions[idx]
+                fused = gctx.fuse_region(
+                    a, tuple(params[m["linear"].attrs["param"]]
+                             for m in r["members"]),
+                    tuple(m["activation"] for m in r["members"]),
+                    r["fold"])
+                if fused is not None:
+                    if ckpt_names:
+                        fused = _checkpoint_name(fused,
+                                                 r["final"].attrs["ckpt"])
+                    vals[r["final"].out] = fused
+                    skipped.update(r["skip"])
+                    continue
+                # declined region: fall through to the per-layer match at
+                # this same index — byte-identical to fusion_depth=1
             if idx in matches:
                 m = matches[idx]
                 fused = gctx.fuse_linear(

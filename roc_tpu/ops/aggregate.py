@@ -580,3 +580,88 @@ def _bnl_bwd(interpret, precision, activation, res, g):
 
 
 scatter_gather_linear_binned.defvjp(_bnl_fwd, _bnl_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Cross-layer megakernel (round 16): a whole fusion region —
+# aggregate -> linear (-> ReLU) -> aggregate -> linear ... — through one
+# Pallas grid; see roc_tpu/ops/pallas/binned.py run_binned_region.
+# ---------------------------------------------------------------------------
+
+def _unfused_region(x, ws, in_degree, plans, interpret, precision,
+                    activations, fold):
+    """The per-layer composition the cross-layer kernel must match:
+    scatter_gather_linear_binned per member, with GCN's folded norm pair
+    (post-scale of layer l + pre-scale of layer l+1) applied between
+    members.  Forward parity oracle AND the region backward's fallback
+    recompute target (jax.vjp of this function is byte-identical to the
+    gradient program the unchained layers would have run)."""
+    from roc_tpu.ops.norm import indegree_norm
+    h = x
+    for d, (w, act) in enumerate(zip(ws, activations)):
+        h = scatter_gather_linear_binned(h, w, plans, interpret,
+                                         precision, act)
+        if fold and d + 1 < len(ws):
+            # the boundary carries both layers' scales: layer d's
+            # post-norm then layer d+1's pre-norm
+            h = indegree_norm(indegree_norm(h, in_degree), in_degree)
+    return h
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def region_linear_binned(x, ws, in_degree, plans: BinnedPlans,
+                         interpret: bool = False, precision: str = "fast",
+                         activations=(), fold: bool = False):
+    """A whole fusion region through one Pallas grid: layer l's
+    post-linear tile feeds layer l+1's aggregation while still in VMEM,
+    so the ``[rows, H]`` inter-layer boundaries never exist in HBM
+    (round 16).  ``ws``/``activations`` are the region's weight and
+    activation chains, head to tail; ``fold`` applies GCN's norm pair at
+    each interior boundary (``in_degree`` participates only then, and is
+    nondifferentiable by ROC's convention — degrees are graph structure).
+    Differentiable w.r.t. x and every w.
+
+    The caller must pre-gate with ``region_ok`` (this primal asserts);
+    the backward self-gates: ``run_binned_region_bwd`` replays the
+    forward in-kernel for relu masks, ping-pongs interior cotangents in
+    VMEM, and accumulates every dW in-kernel — declining to the
+    ``_unfused_region`` jax.vjp oracle when the transposed plan or the
+    VMEM price says no."""
+    from roc_tpu.ops.pallas.binned import run_binned_region
+    assert plans.mm is None, \
+        "region fusion requires a pure binned plan (no hybrid side)"
+    return run_binned_region(x, ws, in_degree, plans.fwd, interpret,
+                             precision, activations, fold)
+
+
+def _rnl_fwd(x, ws, in_degree, plans, interpret, precision, activations,
+             fold):
+    out = region_linear_binned(x, ws, in_degree, plans, interpret,
+                               precision, activations, fold)
+    # saved out is the last layer's relu-mask source; interior masks are
+    # replayed in-kernel by the backward (that's the HBM saving)
+    return out, (x, ws, in_degree, plans, out)
+
+
+def _rnl_bwd(interpret, precision, activations, fold, res, g):
+    x, ws, in_degree, plans, out = res
+    from roc_tpu.ops.pallas.binned import run_binned_region_bwd
+    zero_p = jax.tree.map(
+        lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0), plans)
+    fused = run_binned_region_bwd(g, out, x, ws, in_degree, plans.fwd,
+                                  plans.bwd, interpret, precision,
+                                  activations, fold)
+    if fused is not None:
+        dx, gws = fused
+        return (dx.astype(x.dtype),
+                tuple(gw.astype(w.dtype) for gw, w in zip(gws, ws)),
+                jnp.zeros_like(in_degree), zero_p)
+    _, vjp = jax.vjp(
+        lambda xx, wws: _unfused_region(xx, wws, in_degree, plans,
+                                        interpret, precision, activations,
+                                        fold), x, tuple(ws))
+    gx, gws = vjp(g)
+    return gx, gws, jnp.zeros_like(in_degree), zero_p
+
+
+region_linear_binned.defvjp(_rnl_fwd, _rnl_bwd)

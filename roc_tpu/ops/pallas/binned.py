@@ -853,6 +853,39 @@ def predicted_trainstep_hbm_bytes(num_rows: int, h_in: int, h_out: int,
             + 2 * num_rows * h_out * itemsize)
 
 
+def predicted_xlayer_hbm_bytes(num_rows: int, h: int, depth: int,
+                               itemsize: int = 4) -> int:
+    """Forward HBM bytes of a DEPTH-layer fusion region at uniform width
+    ``h``, in the same scope as predicted_layer_hbm_bytes (OUTSIDE the
+    x-block streaming and staging traffic every mode shares): the region
+    writes only the FINAL [rows, h] output — every interior layer
+    boundary stays in the VMEM inter-layer buffer — and reads each of the
+    ``depth`` weights once.  Compare against depth *
+    predicted_layer_hbm_bytes(..., mega=True): the region drops
+    (depth - 1) output-row writes."""
+    return num_rows * h * itemsize + depth * h * h * 4
+
+
+def predicted_xlayer_trainstep_hbm_bytes(num_rows: int, h: int, depth: int,
+                                         itemsize: int = 4) -> int:
+    """TRAIN-STEP HBM bytes of a DEPTH-layer fusion region, same scope as
+    predicted_trainstep_hbm_bytes.  Forward: predicted_xlayer_hbm_bytes.
+    Backward (_xlayer_bwd_run): the region cotangent g enters and dx
+    leaves at the region boundary (boundary tensors, excluded — exactly
+    as the per-layer accounting excludes them), interior cotangents
+    ping-pong in VMEM, u never exists in HBM (dW accumulates in-kernel),
+    and the relu masks come from the in-kernel forward replay — so the
+    backward's counted traffic is one [rows, h] x re-read for the replay
+    (the analogue of the unfused replay's counted x re-read), ``depth``
+    dW writes, and ``depth`` weight re-reads for the replay.  Versus
+    depth * the per-layer mega+bwd number this drops all 3*depth
+    [rows, h] u/mask trips and (depth - 1) forward output writes — the
+    >=2x cut the CI-gated ``megakernel_xlayer`` budget rows pin
+    (tools/check_kernel_budgets.py check_xlayer_claim)."""
+    fwd = predicted_xlayer_hbm_bytes(num_rows, h, depth, itemsize=itemsize)
+    return fwd + num_rows * h * itemsize + 2 * depth * h * h * 4
+
+
 def padded_rows_for(edge_src: np.ndarray, edge_dst: np.ndarray,
                     geom: Geometry) -> int:
     """ACTUAL slot-padded staging rows for this graph at this geometry:
@@ -2790,6 +2823,860 @@ def run_binned_linear_bwd(g, y, w, plan: BinnedPlan,
                               interpret, exact, geom, relu,
                               1 if G == 1 else 2)
     return u[:plan.num_rows, :Ho], dx[:plan.num_rows, :Hi]
+
+
+# ---------------------------------------------------------------------------
+# CROSS-LAYER megakernel (round 16): a whole fusion REGION —
+# aggregate -> linear (-> relu) [-> fold scales] -> aggregate -> linear ... —
+# in ONE Pallas grid.  The flat fused schedule is depth-agnostic: the grid
+# replays the SAME plan steps once per layer (step = c % S, depth = c // S),
+# and layer d's post-linear [RB, H] tiles accumulate into a VMEM-resident
+# inter-layer buffer that layer d+1's phase-1 staging reads back at block
+# granularity — the [rows, H] layer boundary never touches HBM for
+# shard-local rows.  Per-depth weights ride a stacked [D, Hm, Hm] input
+# whose (1, Hm, Hm) BlockSpec double-buffers the NEXT depth's tile while
+# the current one computes.  Admission (region_ok) additionally requires a
+# SQUARE shard-local plan (table_rows == num_rows: no halo frontier — the
+# SPMD path keeps per-layer fusion) and full bin coverage of the block
+# range (out_rows >= padded table_rows) so every inter-layer block read
+# lands in a window the schedule zeroed (every bin opens with first=1,
+# empty bins included — _attach_fused).
+# ---------------------------------------------------------------------------
+
+# ROC_XLAYER=0 kill switch: disables REGION fusion only — per-layer
+# megakernels (rounds 8-12) keep running, restoring PR-10 behavior
+# exactly.  Warn-once like the other program-changing switches.
+_XLAYER_KILL_WARNED = [False]
+
+
+def xlayer_killed() -> bool:
+    """True when ROC_XLAYER=0 disables cross-layer fusion-region kernels
+    at runtime (checked at every region dispatch; warn-once).  Per-layer
+    megakernel fusion is unaffected."""
+    if os.environ.get("ROC_XLAYER", "") != "0":
+        return False
+    if not _XLAYER_KILL_WARNED[0]:
+        _XLAYER_KILL_WARNED[0] = True
+        warnings.warn(
+            "ROC_XLAYER=0: cross-layer fusion regions disabled; eligible "
+            "regions run the per-layer megakernel chain instead.",
+            stacklevel=2)
+    return True
+
+
+def _xlayer_vmem_ok(geom: Geometry, Hm_p: int, c2: int, depth: int,
+                    groups: int = 2, tp: int = 0) -> bool:
+    """Trace-time admission for the cross-layer FORWARD grid: the
+    per-layer megakernel's residents (_mega_vmem_ok) at the region's
+    uniform padded width, with the weight tile DOUBLE-buffered (its block
+    index now changes once per depth), plus the inter-layer VMEM buffers
+    — one [tp, Hm] activation plane for depth 2, two (ping-pong) beyond.
+    This is the term that keys region admission to SHARD-local row
+    counts: at full-graph scale tp*Hm busts the budget and the planner
+    declines down to per-layer fusion."""
+    srows = c2 * geom.ch2
+    stg = staging_itemsize(geom, False)
+    nparity = 1 if groups == 1 else 2
+    ipar = 1 if depth == 2 else 2
+    need = (nparity * srows * Hm_p * stg + geom.ch * Hm_p * stg
+            + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
+            + 2 * geom.sb * Hm_p * 4
+            + 2 * Hm_p * Hm_p * 4        # per-depth weight, double-buffered
+            + geom.rb * Hm_p * 4         # per-chunk aggregate tile
+            + geom.rb * Hm_p * 4         # out window
+            + ipar * tp * Hm_p * 4)      # inter-layer activation planes
+    return need <= _VMEM_BUDGET
+
+
+def _xlayer_bwd_vmem_ok(geom: Geometry, Hm_p: int, c2: int, depth: int,
+                        groups: int = 2, tp: int = 0,
+                        relu_last: bool = False) -> bool:
+    """Trace-time admission for the cross-layer BACKWARD grid: staging +
+    one-hot residents at the region width, the streamed blocks (x pair
+    for the replay, cotangent pair, saved-output pair when the last layer
+    fused a relu, plus the dW z-window), BOTH stacked weight inputs and
+    the dW out block double-buffered, and the big ones — (depth-1)
+    replayed activation planes plus the cotangent ping-pong."""
+    srows = c2 * geom.ch2
+    stg = staging_itemsize(geom, False)
+    nparity = 1 if groups == 1 else 2
+    ncg = 1 if depth == 2 else 2
+    need = (nparity * srows * Hm_p * stg + geom.ch * Hm_p * stg
+            + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2
+            + (4 + (2 if relu_last else 0)) * geom.sb * Hm_p * 4
+            + geom.rb * Hm_p * 4         # dW z window
+            + 4 * Hm_p * Hm_p * 4        # ws + wst, double-buffered
+            + 2 * Hm_p * Hm_p * 4        # dW out block, double-buffered
+            + geom.rb * Hm_p * 4         # per-chunk cotangent tile
+            + geom.rb * Hm_p * 4         # dx out window
+            + (depth - 1 + ncg) * tp * Hm_p * 4)  # replay + cotangent
+    return need <= _VMEM_BUDGET
+
+
+def region_ok(plan: BinnedPlan, widths, precision: str = "fast",
+              x_dtype=jnp.float32) -> bool:
+    """Trace-time admission for a fusion REGION over this (forward) plan.
+    ``widths`` is the region's feature-width chain (H_0, H_1, ..., H_D);
+    all gating is static, so a False here lets the executor hook decline
+    and the per-layer (depth-1) program run byte-identical.  Mirrors the
+    per-layer megakernel gates plus the region-only ones: >=2 layers, a
+    square shard-local plan (table_rows == num_rows — halo-frontier rows
+    would read garbage from the inter-layer buffer), bin coverage of the
+    whole block range, the ROC_XLAYER kill switch, and the region VMEM
+    price."""
+    geom = plan.geom or _default_geom()
+    depth = len(widths) - 1
+    exact = precision == "exact" and x_dtype == jnp.float32
+    if depth < 2 or geom is None or not geom.flat:
+        return False
+    if plan.f_meta is None or plan.f_last is None:
+        return False
+    Hm_p = max(_pad_to(int(h), 128) for h in widths)
+    C2 = plan.p2_obi.shape[1]
+    G = plan.p1_blk.shape[0]
+    out_rows = G * plan.bins_per_group * geom.rb
+    tp = _pad_to(max(_pad_to(plan.table_rows, geom.sb), out_rows),
+                 max(geom.sb, geom.rb))
+    return (not (exact and geom.unit == 16)
+            and not os.environ.get("ROC_BINNED_NO_FUSE")
+            and not megafuse_killed()
+            and not xlayer_killed()
+            and plan.table_rows == plan.num_rows
+            and out_rows >= _pad_to(plan.table_rows, geom.sb)
+            and _xlayer_vmem_ok(geom, Hm_p, C2, depth, groups=G, tp=tp))
+
+
+def _xlayer_kernel(*args, exact: bool = False, geom: Geometry = None,
+                   depth: int = 2, nsteps_per: int = 0, relus=(),
+                   fold: bool = False):
+    """Cross-layer forward: grid step c runs plan step c % S at depth
+    c // S.  Depth 0's phase 1 stages from the x HBM blocks exactly like
+    _mega_kernel; depth d>0 stages from the inter-layer VMEM plane that
+    depth d-1's phase 2 filled (parity (d-1) % ipar).  Phase 2 at the
+    LAST depth accumulates tile @ W_d into the HBM out window (index
+    pinned to 0 on earlier depths: block 0 is also the first real bin,
+    so its first=1 zeroing lands before any real writeback); earlier
+    depths accumulate into their inter-layer window and, on the bin's
+    last real chunk (f_last), apply the layer epilogue in place — relu,
+    then for norm-folded regions the two diagonal scales (v*s)*s, the
+    exact multiply sequence the per-layer hook runs outside the kernel,
+    so the staged values match the depth-1 chain bitwise on fp32."""
+    if fold:
+        (blk_ref, blk2_ref, obi_ref, last_ref, meta_ref, dsrc_ref,
+         ddst_ref, rows_ref, x_ref, x2_ref, ws_ref, s_ref, out_ref,
+         gbuf, stgbuf, tbuf, sems) = args
+    else:
+        (blk_ref, blk2_ref, obi_ref, last_ref, meta_ref, dsrc_ref,
+         ddst_ref, rows_ref, x_ref, x2_ref, ws_ref, out_ref,
+         gbuf, stgbuf, tbuf, sems) = args
+        s_ref = None
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    U = geom.unit_rows
+    st = staging_dtype(geom, exact)
+    S = nsteps_per
+    D = depth
+    ipar = 1 if D == 2 else 2
+    c = pl.program_id(0)
+    step = c % S
+    d = c // S
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+        sl = rows_ref[:]
+        t1 = (lane == sl).astype(jnp.bfloat16)
+        t2 = (lane == sl - SB).astype(jnp.bfloat16)
+        two = blk2_ref[step] != blk_ref[step]
+
+        @pl.when(d == 0)
+        def _():
+            gbuf[:] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())),
+                                  exact).astype(st)
+
+            @pl.when(two)
+            def _():
+                gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                    t2, x2_ref[:], (((1,), (0,)), ((), ())),
+                    exact)).astype(st)
+
+        for dd in range(1, D):
+            @pl.when(d == dd)
+            def _(dd=dd):
+                j = (dd - 1) % ipar
+                src = tbuf[j, pl.ds(blk_ref[step] * SB, SB), :]
+                gbuf[:] = _onehot_dot(t1, src, (((1,), (0,)), ((), ())),
+                                      exact).astype(st)
+
+                @pl.when(two)
+                def _(j=j):
+                    src2 = tbuf[j, pl.ds(blk2_ref[step] * SB, SB), :]
+                    gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                        t2, src2, (((1,), (0,)), ((), ())),
+                        exact)).astype(st)
+
+        def issue(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).start()
+            return 0
+        jax.lax.fori_loop(0, KD, issue, 0)
+
+        def drain(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).wait()
+            return 0
+        jax.lax.fori_loop(0, KD, drain, 0)
+
+    @pl.when(kind == 1)
+    def _():
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        rows = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        s_t = (lane == dl).astype(jnp.bfloat16)
+        tile = _onehot_dot(s_t, rows, (((0,), (0,)), ((), ())), exact)
+        contrib = jax.lax.dot_general(
+            tile, ws_ref[0], (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+
+        @pl.when(d == D - 1)
+        def _():
+            @pl.when(first == 1)
+            def _():
+                out_ref[:] = jnp.zeros_like(out_ref)
+
+            out_ref[:] += contrib
+            if relus[-1]:
+                @pl.when(last_ref[step] == 1)
+                def _():
+                    out_ref[:] = jnp.maximum(out_ref[:], 0.0)
+
+        for dd in range(D - 1):
+            @pl.when(d == dd)
+            def _(dd=dd):
+                j = dd % ipar
+                off = obi_ref[step] * RB
+
+                @pl.when(first == 1)
+                def _(j=j):
+                    tbuf[j, pl.ds(off, RB), :] = jnp.zeros(
+                        (RB, tbuf.shape[-1]), jnp.float32)
+
+                tbuf[j, pl.ds(off, RB), :] = (
+                    tbuf[j, pl.ds(off, RB), :] + contrib)
+
+                @pl.when(last_ref[step] == 1)
+                def _(dd=dd, j=j):
+                    v = tbuf[j, pl.ds(off, RB), :]
+                    if relus[dd]:
+                        v = jnp.maximum(v, 0.0)
+                    if fold:
+                        v = (v * s_ref[:]) * s_ref[:]
+                    tbuf[j, pl.ds(off, RB), :] = v
+
+
+@partial(jax.jit, static_argnames=("nsteps_per", "c2", "out_rows", "tp",
+                                   "interpret", "exact", "geom", "depth",
+                                   "relus", "fold", "nparity"))
+def _xlayer_run(x, ws, s, blk, blk2, obi, last, meta, dsrc, ddst, rows,
+                nsteps_per: int, c2: int, out_rows: int, tp: int,
+                interpret: bool = False, exact: bool = False,
+                geom: Geometry = None, depth: int = 2, relus=(),
+                fold: bool = False, nparity: int = 2):
+    Hm = x.shape[-1]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    S = nsteps_per
+    D = depth
+    srows = c2 * geom.ch2
+    ipar = 1 if D == 2 else 2
+    in_specs = [
+        pl.BlockSpec((8, 4), lambda c, b, b2, o, l: ((c % S) // 8, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((8, KD), lambda c, b, b2, o, l: ((c % S) // 8, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((8, KD), lambda c, b, b2, o, l: ((c % S) // 8, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((CH, 1), lambda c, b, b2, o, l: (c % S, 0)),
+        # x blocks stream at depth 0 only; pinned to block 0 above so the
+        # buffer never refetches while the inter-layer planes feed
+        pl.BlockSpec((SB, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where(c // S == 0, b[c % S], 0), 0)),
+        pl.BlockSpec((SB, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where(c // S == 0, b2[c % S], 0), 0)),
+        # stacked per-depth weights: the block index changes once per
+        # depth, so pallas double-buffers the NEXT layer's tile
+        pl.BlockSpec((1, Hm, Hm), lambda c, b, b2, o, l: (c // S, 0, 0)),
+    ]
+    if fold:
+        in_specs.append(
+            pl.BlockSpec((RB, 1), lambda c, b, b2, o, l: (o[c % S], 0)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,                  # blk, blk2, obi, last [S]
+        grid=(D * S,),
+        in_specs=in_specs,
+        # real out windows on the last depth only; the pin to block 0 on
+        # earlier depths is safe because the out index is nondecreasing
+        # from bin 0, whose first=1 zeroing precedes any writeback
+        out_specs=pl.BlockSpec(
+            (RB, Hm),
+            lambda c, b, b2, o, l: (
+                jnp.where(c // S == D - 1, o[c % S], 0), 0)),
+        scratch_shapes=[pltpu.VMEM((CH, Hm), staging_dtype(geom, exact)),
+                        pltpu.VMEM((nparity, srows, Hm),
+                                   staging_dtype(geom, exact)),
+                        pltpu.VMEM((ipar, tp, Hm), jnp.float32),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    ins = (blk, blk2, obi, last, meta, dsrc, ddst, rows, x, x, ws)
+    ins += (s,) if fold else ()
+    return pl.pallas_call(
+        partial(_xlayer_kernel, exact=exact, geom=geom, depth=D,
+                nsteps_per=S, relus=relus, fold=fold),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_rows, Hm), jnp.float32),
+        interpret=interpret,
+    )(*ins)
+
+
+def run_binned_region(x, ws, in_degree, plan: BinnedPlan,
+                      interpret: bool = False, precision: str = "fast",
+                      activations=(), fold: bool = False):
+    """relu_D(A ... relu_1(A (x W_1)) W_2 ...) — a whole fusion region in
+    ONE Pallas grid.  ``ws`` is the region's weight chain (depth =
+    len(ws) >= 2), ``activations`` the per-layer "none"/"relu" chain, and
+    for norm-folded (GCN) regions ``fold=True`` applies the interior
+    (D^-1/2)^2 diagonal scales in-kernel from ``in_degree`` (the caller
+    still owns the region-boundary pre/post scales, exactly like the
+    per-layer hook).  The caller MUST pre-gate with region_ok — this
+    asserts it, because a half-admitted region has no cheap fallback
+    composition at this level (ops.aggregate.region_linear_binned owns
+    the differentiable wrapper and oracle)."""
+    if any(a not in ("none", "relu") for a in activations):
+        raise ValueError(f"activations={activations!r}: the region kernel "
+                         f"fuses 'none' or 'relu' only")
+    if precision not in ("fast", "exact"):
+        raise ValueError(f"precision={precision!r}: must be 'fast' or "
+                         f"'exact'")
+    D = len(ws)
+    widths = (x.shape[-1],) + tuple(w.shape[-1] for w in ws)
+    assert region_ok(plan, widths, precision, x.dtype), \
+        "run_binned_region called without region_ok admission"
+    exact = precision == "exact" and x.dtype == jnp.float32
+    geom = plan.geom or _default_geom()
+    Hm = max(_pad_to(int(h), 128) for h in widths)
+    C2 = plan.p2_obi.shape[1]
+    G = plan.p1_blk.shape[0]
+    out_rows = G * plan.bins_per_group * geom.rb
+    rows_pad = _pad_to(plan.table_rows, geom.sb)
+    tp = _pad_to(max(rows_pad, out_rows), max(geom.sb, geom.rb))
+    xp = jnp.pad(x, ((0, rows_pad - x.shape[0]), (0, Hm - x.shape[-1])))
+    wsp = jnp.stack([jnp.pad(w.astype(jnp.float32),
+                             ((0, Hm - w.shape[0]), (0, Hm - w.shape[1])))
+                     for w in ws])
+    sp = None
+    if fold:
+        # the EXACT per-row multiplier ops.indegree_norm applies (x *
+        # rsqrt(deg)); pad rows scale by 1 so zeros stay zeros
+        sp = jnp.pad(jax.lax.rsqrt(in_degree)[:, None],
+                     ((0, tp - in_degree.shape[0]), (0, 0)),
+                     constant_values=1.0)
+    relus = tuple(a == "relu" for a in activations)
+    S = int(plan.f_blk.shape[0])
+    with jax.named_scope("roc_binned_xlayer"):
+        out = _xlayer_run(xp, wsp, sp, plan.f_blk, plan.f_blk2, plan.f_obi,
+                          plan.f_last, plan.f_meta, plan.f_dsrc,
+                          plan.f_ddst, plan.f_rows, S, C2, out_rows, tp,
+                          interpret, exact, geom, D, relus, fold,
+                          1 if G == 1 else 2)
+    return out[:plan.num_rows, :ws[-1].shape[-1]].astype(x.dtype)
+
+
+def _xlayer_bwd_kernel(*args, exact: bool = False, geom: Geometry = None,
+                       depth: int = 2, sf: int = 0, sbs: int = 0, relus=(),
+                       fold: bool = False):
+    """Cross-layer backward: one grid, two phases.  Steps [0, (D-1)*sf)
+    REPLAY the forward over the fwd plan (arrays [0, sf) of the
+    concatenated schedule), filling the (D-1) inter-layer activation
+    planes — scaled form for fold, exactly what the per-layer chain
+    staged.  Steps after run D sweeps of the TRANSPOSED plan (arrays
+    [sf, sf+sbs)), layer order ld = D-1-db: phase 1 stages the layer's
+    output cotangent — from g HBM blocks at db=0 (masked by the saved
+    region output, the per-layer rule) or from the cotangent ping-pong
+    plane at db>0 (fold scales (s*)(s*) then the replayed-plane relu
+    mask, the exact per-layer outside-ops order) — and phase 2
+    accumulates BOTH gradients per chunk: dW_ld += z^T @ tile in the
+    resident [1, Hm, Hm] dW block (z = the replayed previous-layer plane
+    window, or the x window at ld=0; valid by distributivity — the same
+    z window spans all of a bin's chunks, and masked pad rows contribute
+    exact zeros) and the cotangent hand-off tile @ W_ld^T into the
+    OTHER ping-pong parity (or the dx HBM window at db=D-1).  u never
+    exists in HBM; each dW block zeroes at its depth's first step."""
+    args = list(args)
+    blk_ref, blk2_ref, obi_ref, last_ref = args[:4]
+    (meta_ref, dsrc_ref, ddst_ref, rows_ref,
+     x_ref, x2_ref, g_ref, g2_ref) = args[4:12]
+    i = 12
+    if relus[-1]:
+        y_ref, y2_ref = args[i:i + 2]
+        i += 2
+    else:
+        y_ref = y2_ref = None
+    xw_ref, ws_ref, wst_ref = args[i:i + 3]
+    i += 3
+    if fold:
+        s_ref, sb1_ref, sb2_ref = args[i:i + 3]
+        i += 3
+    else:
+        s_ref = sb1_ref = sb2_ref = None
+    dw_ref, dx0_ref, gbuf, stgbuf, tbuf, cg, sems = args[i:]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    U = geom.unit_rows
+    st = staging_dtype(geom, exact)
+    D = depth
+    RPT = (D - 1) * sf
+    NCG = 1 if D == 2 else 2
+    c = pl.program_id(0)
+    in_rep = c < RPT
+    in_bwd = jnp.logical_not(in_rep)
+    cb = c - RPT
+    pidx = jnp.where(in_rep, c % sf, sf + cb % sbs)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    # the resident dW block zeroes at its depth's first step (the block
+    # index just switched to this depth, so the fetched content is HBM
+    # garbage or a stale writeback — never real)
+    @pl.when(in_bwd & (cb % sbs == 0))
+    def _():
+        dw_ref[...] = jnp.zeros(dw_ref.shape, jnp.float32)
+
+    @pl.when(kind == 0)
+    def _():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+        sl = rows_ref[:]
+        t1 = (lane == sl).astype(jnp.bfloat16)
+        t2 = (lane == sl - SB).astype(jnp.bfloat16)
+        two = blk2_ref[pidx] != blk_ref[pidx]
+
+        @pl.when(in_rep & (c < sf))
+        def _():
+            gbuf[:] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())),
+                                  exact).astype(st)
+
+            @pl.when(two)
+            def _():
+                gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                    t2, x2_ref[:], (((1,), (0,)), ((), ())),
+                    exact)).astype(st)
+
+        for dd in range(1, D - 1):
+            @pl.when(in_rep & (c // sf == dd))
+            def _(dd=dd):
+                src = tbuf[dd - 1, pl.ds(blk_ref[pidx] * SB, SB), :]
+                gbuf[:] = _onehot_dot(t1, src, (((1,), (0,)), ((), ())),
+                                      exact).astype(st)
+
+                @pl.when(two)
+                def _(dd=dd):
+                    src2 = tbuf[dd - 1, pl.ds(blk2_ref[pidx] * SB, SB), :]
+                    gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                        t2, src2, (((1,), (0,)), ((), ())),
+                        exact)).astype(st)
+
+        @pl.when(in_bwd & (cb < sbs))
+        def _():
+            gv = g_ref[:]
+            gv2 = g2_ref[:]
+            if relus[-1]:
+                gv = jnp.where(y_ref[:] > 0, gv, jnp.zeros_like(gv))
+                gv2 = jnp.where(y2_ref[:] > 0, gv2, jnp.zeros_like(gv2))
+            gbuf[:] = _onehot_dot(t1, gv, (((1,), (0,)), ((), ())),
+                                  exact).astype(st)
+
+            @pl.when(two)
+            def _():
+                gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                    t2, gv2, (((1,), (0,)), ((), ())), exact)).astype(st)
+
+        for dbs in range(1, D):
+            @pl.when(in_bwd & (cb // sbs == dbs))
+            def _(dbs=dbs):
+                ld = D - 1 - dbs
+                gv = cg[(dbs - 1) % NCG,
+                        pl.ds(blk_ref[pidx] * SB, SB), :]
+                if fold:
+                    gv = (gv * sb1_ref[:]) * sb1_ref[:]
+                if relus[ld]:
+                    msk = tbuf[ld, pl.ds(blk_ref[pidx] * SB, SB), :]
+                    gv = jnp.where(msk > 0, gv, jnp.zeros_like(gv))
+                gbuf[:] = _onehot_dot(t1, gv, (((1,), (0,)), ((), ())),
+                                      exact).astype(st)
+
+                @pl.when(two)
+                def _(dbs=dbs, ld=ld):
+                    gv2 = cg[(dbs - 1) % NCG,
+                             pl.ds(blk2_ref[pidx] * SB, SB), :]
+                    if fold:
+                        gv2 = (gv2 * sb2_ref[:]) * sb2_ref[:]
+                    if relus[ld]:
+                        msk2 = tbuf[ld,
+                                    pl.ds(blk2_ref[pidx] * SB, SB), :]
+                        gv2 = jnp.where(msk2 > 0, gv2,
+                                        jnp.zeros_like(gv2))
+                    gbuf[:] = (gbuf[:].astype(jnp.float32) + _onehot_dot(
+                        t2, gv2, (((1,), (0,)), ((), ())),
+                        exact)).astype(st)
+
+        def issue(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).start()
+            return 0
+        jax.lax.fori_loop(0, KD, issue, 0)
+
+        def drain(e, _):
+            v = dsrc_ref[c % 8, e]
+
+            @pl.when(v >= 0)
+            def _():
+                cls = v // 65536
+                su = v - cls * 65536
+                du = ddst_ref[c % 8, e]
+                for ci, csz in enumerate(_DMA_CLS):
+                    @pl.when(cls == ci)
+                    def _(csz=csz):
+                        pltpu.make_async_copy(
+                            gbuf.at[pl.ds(su * U, csz * U)],
+                            stgbuf.at[par].at[
+                                pl.ds(du * U, csz * U)],
+                            sems.at[0]).wait()
+            return 0
+        jax.lax.fori_loop(0, KD, drain, 0)
+
+    @pl.when(kind == 1)
+    def _():
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        rows = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        s_t = (lane == dl).astype(jnp.bfloat16)
+        tile = _onehot_dot(s_t, rows, (((0,), (0,)), ((), ())), exact)
+
+        for dd in range(D - 1):
+            @pl.when(in_rep & (c // sf == dd))
+            def _(dd=dd):
+                contrib = jax.lax.dot_general(
+                    tile, ws_ref[0], (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
+                off = obi_ref[pidx] * RB
+
+                @pl.when(first == 1)
+                def _(dd=dd):
+                    tbuf[dd, pl.ds(off, RB), :] = jnp.zeros(
+                        (RB, tbuf.shape[-1]), jnp.float32)
+
+                tbuf[dd, pl.ds(off, RB), :] = (
+                    tbuf[dd, pl.ds(off, RB), :] + contrib)
+
+                @pl.when(last_ref[pidx] == 1)
+                def _(dd=dd):
+                    v = tbuf[dd, pl.ds(off, RB), :]
+                    if relus[dd]:
+                        v = jnp.maximum(v, 0.0)
+                    if fold:
+                        v = (v * s_ref[:]) * s_ref[:]
+                    tbuf[dd, pl.ds(off, RB), :] = v
+
+        for dbs in range(D):
+            @pl.when(in_bwd & (cb // sbs == dbs))
+            def _(dbs=dbs):
+                ld = D - 1 - dbs
+                off = obi_ref[pidx] * RB
+                if ld == 0:
+                    z = xw_ref[:]
+                else:
+                    z = tbuf[ld - 1, pl.ds(off, RB), :]
+                dw_ref[0] = dw_ref[0] + jax.lax.dot_general(
+                    z, tile, (((0,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
+                dxc = jax.lax.dot_general(
+                    tile, wst_ref[0], (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
+                if dbs == D - 1:
+                    @pl.when(first == 1)
+                    def _():
+                        dx0_ref[:] = jnp.zeros_like(dx0_ref)
+
+                    dx0_ref[:] += dxc
+                else:
+                    j = dbs % NCG
+
+                    @pl.when(first == 1)
+                    def _(j=j):
+                        cg[j, pl.ds(off, RB), :] = jnp.zeros(
+                            (RB, cg.shape[-1]), jnp.float32)
+
+                    cg[j, pl.ds(off, RB), :] = (
+                        cg[j, pl.ds(off, RB), :] + dxc)
+
+
+@partial(jax.jit, static_argnames=("sf", "sbs", "c2", "out_rows", "tp",
+                                   "interpret", "exact", "geom", "depth",
+                                   "relus", "fold", "nparity"))
+def _xlayer_bwd_run(x, g, y, ws, wst, s, blk, blk2, obi, last, meta, dsrc,
+                    ddst, rows, sf: int, sbs: int, c2: int, out_rows: int,
+                    tp: int, interpret: bool = False, exact: bool = False,
+                    geom: Geometry = None, depth: int = 2, relus=(),
+                    fold: bool = False, nparity: int = 2):
+    Hm = x.shape[-1]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    D = depth
+    RPT = (D - 1) * sf
+    srows = c2 * geom.ch2
+    ncg = 1 if D == 2 else 2
+
+    def pidx(c):
+        return jnp.where(c < RPT, c % sf, sf + (c - RPT) % sbs)
+
+    in_specs = [
+        pl.BlockSpec((8, 4), lambda c, b, b2, o, l: (pidx(c) // 8, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((8, KD), lambda c, b, b2, o, l: (pidx(c) // 8, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((8, KD), lambda c, b, b2, o, l: (pidx(c) // 8, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((CH, 1), lambda c, b, b2, o, l: (pidx(c), 0)),
+        # x blocks feed the replay's depth 0 only
+        pl.BlockSpec((SB, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where(c < sf, b[pidx(c)], 0), 0)),
+        pl.BlockSpec((SB, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where(c < sf, b2[pidx(c)], 0), 0)),
+        # region-output cotangent blocks feed the backward's first sweep
+        pl.BlockSpec((SB, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where((c >= RPT) & (c < RPT + sbs),
+                                   b[pidx(c)], 0), 0)),
+        pl.BlockSpec((SB, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where((c >= RPT) & (c < RPT + sbs),
+                                   b2[pidx(c)], 0), 0)),
+    ]
+    if relus[-1]:
+        in_specs += [
+            pl.BlockSpec((SB, Hm),
+                         lambda c, b, b2, o, l: (
+                             jnp.where((c >= RPT) & (c < RPT + sbs),
+                                       b[pidx(c)], 0), 0)),
+            pl.BlockSpec((SB, Hm),
+                         lambda c, b, b2, o, l: (
+                             jnp.where((c >= RPT) & (c < RPT + sbs),
+                                       b2[pidx(c)], 0), 0)),
+        ]
+    in_specs += [
+        # dW z windows at layer 0 (the last backward sweep)
+        pl.BlockSpec((RB, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where(c >= RPT + (D - 1) * sbs,
+                                   o[pidx(c)], 0), 0)),
+        pl.BlockSpec((1, Hm, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where(c < RPT, c // sf, 0), 0, 0)),
+        pl.BlockSpec((1, Hm, Hm),
+                     lambda c, b, b2, o, l: (
+                         jnp.where(c >= RPT,
+                                   D - 1 - (c - RPT) // sbs, 0), 0, 0)),
+    ]
+    if fold:
+        in_specs += [
+            pl.BlockSpec((RB, 1),
+                         lambda c, b, b2, o, l: (
+                             jnp.where(c < RPT, o[pidx(c)], 0), 0)),
+            pl.BlockSpec((SB, 1),
+                         lambda c, b, b2, o, l: (
+                             jnp.where(c >= RPT, b[pidx(c)], 0), 0)),
+            pl.BlockSpec((SB, 1),
+                         lambda c, b, b2, o, l: (
+                             jnp.where(c >= RPT, b2[pidx(c)], 0), 0)),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,      # concatenated blk, blk2, obi, last
+        grid=(RPT + D * sbs,),
+        in_specs=in_specs,
+        out_specs=[
+            # per-depth dW blocks: index walks D-1 .. 0 across the
+            # backward sweeps (block 0's stale replay-phase writeback is
+            # overwritten by its real depth, which runs LAST)
+            pl.BlockSpec((1, Hm, Hm),
+                         lambda c, b, b2, o, l: (
+                             jnp.where(c >= RPT,
+                                       D - 1 - (c - RPT) // sbs, 0),
+                             0, 0)),
+            pl.BlockSpec((RB, Hm),
+                         lambda c, b, b2, o, l: (
+                             jnp.where(c >= RPT + (D - 1) * sbs,
+                                       o[pidx(c)], 0), 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((CH, Hm), staging_dtype(geom, exact)),
+                        pltpu.VMEM((nparity, srows, Hm),
+                                   staging_dtype(geom, exact)),
+                        pltpu.VMEM((D - 1, tp, Hm), jnp.float32),
+                        pltpu.VMEM((ncg, tp, Hm), jnp.float32),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    ins = (blk, blk2, obi, last, meta, dsrc, ddst, rows, x, x, g, g)
+    ins += (y, y) if relus[-1] else ()
+    ins += (x, ws, wst)
+    ins += (s, s, s) if fold else ()
+    return pl.pallas_call(
+        partial(_xlayer_bwd_kernel, exact=exact, geom=geom, depth=D,
+                sf=sf, sbs=sbs, relus=relus, fold=fold),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((D, Hm, Hm), jnp.float32),
+                   jax.ShapeDtypeStruct((out_rows, Hm), jnp.float32)],
+        interpret=interpret,
+    )(*ins)
+
+
+def run_binned_region_bwd(g, y, x, ws, in_degree, fwd_plan: BinnedPlan,
+                          bwd_plan: BinnedPlan, interpret: bool = False,
+                          precision: str = "fast", activations=(),
+                          fold: bool = False):
+    """Fused backward of a whole fusion region: given the region-output
+    cotangent g, the saved region output y (last-layer relu mask source),
+    the saved region input x and weight chain ws, returns
+    (dx [rows, H_0], (dW_1, ..., dW_D)) — interior cotangents ping-pong
+    in VMEM, the relu masks come from an in-kernel forward replay, and
+    every dW accumulates in-kernel (u never exists in HBM).  Integer
+    data reproduces the per-layer-fused chain bitwise; fp32 dW
+    reassociates (bin-ordered adds vs one XLA GEMM) within the
+    documented ULP bound.
+
+    Returns None when ANY admission gate fails (region_ok on the forward
+    plan, the transposed plan's own fused-schedule/geometry gates,
+    ROC_MEGA_BWD=0, or the backward VMEM price): the caller replays the
+    per-layer composition under jax.vjp — the bitwise oracle."""
+    if precision not in ("fast", "exact"):
+        raise ValueError(f"precision={precision!r}: must be 'fast' or "
+                         f"'exact'")
+    D = len(ws)
+    widths = (x.shape[-1],) + tuple(w.shape[-1] for w in ws)
+    geom = fwd_plan.geom or _default_geom()
+    relus = tuple(a == "relu" for a in activations)
+    if not region_ok(fwd_plan, widths, precision, x.dtype):
+        return None
+    if mega_bwd_killed():
+        return None
+    bgeom = bwd_plan.geom or _default_geom()
+    if (bgeom != geom or bwd_plan.f_meta is None
+            or bwd_plan.f_last is None
+            or bwd_plan.table_rows != bwd_plan.num_rows):
+        return None
+    Hm = max(_pad_to(int(h), 128) for h in widths)
+    C2f = fwd_plan.p2_obi.shape[1]
+    C2b = bwd_plan.p2_obi.shape[1]
+    C2 = max(C2f, C2b)
+    Gf = fwd_plan.p1_blk.shape[0]
+    Gb = bwd_plan.p1_blk.shape[0]
+    out_rows_f = Gf * fwd_plan.bins_per_group * geom.rb
+    out_rows_b = Gb * bwd_plan.bins_per_group * geom.rb
+    rows_pad = _pad_to(fwd_plan.table_rows, geom.sb)
+    if out_rows_b < _pad_to(bwd_plan.table_rows, geom.sb):
+        return None
+    tp = _pad_to(max(rows_pad, out_rows_f, out_rows_b),
+                 max(geom.sb, geom.rb))
+    if not _xlayer_bwd_vmem_ok(geom, Hm, C2, D, groups=max(Gf, Gb), tp=tp,
+                               relu_last=relus[-1]):
+        return None
+    exact = precision == "exact" and x.dtype == jnp.float32
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, tp - x.shape[0]), (0, Hm - x.shape[-1])))
+    gp = jnp.pad(g.astype(jnp.float32),
+                 ((0, tp - g.shape[0]), (0, Hm - g.shape[-1])))
+    yp = jnp.pad(y.astype(jnp.float32),
+                 ((0, tp - y.shape[0]), (0, Hm - y.shape[-1]))) \
+        if relus[-1] else None
+    wsp = jnp.stack([jnp.pad(w.astype(jnp.float32),
+                             ((0, Hm - w.shape[0]), (0, Hm - w.shape[1])))
+                     for w in ws])
+    wstp = jnp.stack([jnp.pad(jnp.transpose(w.astype(jnp.float32)),
+                              ((0, Hm - w.shape[1]), (0, Hm - w.shape[0])))
+                      for w in ws])
+    sp = None
+    if fold:
+        sp = jnp.pad(jax.lax.rsqrt(in_degree)[:, None],
+                     ((0, tp - in_degree.shape[0]), (0, 0)),
+                     constant_values=1.0)
+    blkc = jnp.concatenate([fwd_plan.f_blk, bwd_plan.f_blk])
+    blk2c = jnp.concatenate([fwd_plan.f_blk2, bwd_plan.f_blk2])
+    obic = jnp.concatenate([fwd_plan.f_obi, bwd_plan.f_obi])
+    lastc = jnp.concatenate([fwd_plan.f_last, bwd_plan.f_last])
+    metac = jnp.concatenate([fwd_plan.f_meta, bwd_plan.f_meta])
+    dsrcc = jnp.concatenate([fwd_plan.f_dsrc, bwd_plan.f_dsrc])
+    ddstc = jnp.concatenate([fwd_plan.f_ddst, bwd_plan.f_ddst])
+    rowsc = jnp.concatenate([fwd_plan.f_rows, bwd_plan.f_rows])
+    Sf = int(fwd_plan.f_blk.shape[0])
+    Sb = int(bwd_plan.f_blk.shape[0])
+    nparity = 1 if max(Gf, Gb) == 1 else 2
+    with jax.named_scope("roc_binned_xlayer_bwd"):
+        dws, dx0 = _xlayer_bwd_run(xp, gp, yp, wsp, wstp, sp, blkc, blk2c,
+                                   obic, lastc, metac, dsrcc, ddstc, rowsc,
+                                   Sf, Sb, C2, out_rows_b, tp, interpret,
+                                   exact, geom, D, relus, fold, nparity)
+    dx = dx0[:bwd_plan.num_rows, :widths[0]]
+    gws = tuple(dws[d, :ws[d].shape[0], :ws[d].shape[1]]
+                for d in range(D))
+    return dx, gws
 
 
 # one-shot: the eager path is a silent ~9x dispatch-overhead footgun

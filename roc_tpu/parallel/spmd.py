@@ -107,6 +107,15 @@ class ShardedGraphData:
     # tree_structure(gd) so the step cache provably re-traces.
     mega_bwd: bool = dataclasses.field(default=False,
                                        metadata={"static": True})
+    # Cross-layer fusion-region cap (round 16, config.fusion_depth).
+    # Same honesty contract as megafuse/mega_bwd: sharded steps never run
+    # the region kernel today (f_* schedules are stripped at shard
+    # stacking, so fuse_region stays None), but the field keys the step
+    # cache so depth flips between trainer builds are provably retraces —
+    # and so zero-retrace pins hold with a region active on the
+    # single-device path feeding the same cache signature discipline.
+    fusion_depth: int = dataclasses.field(default=1,
+                                          metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
@@ -115,7 +124,7 @@ jax.tree_util.register_dataclass(
                  "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans",
                  "plans_local", "plans_remote"],
     meta_fields=["backend", "mode", "precision", "xch_dtype", "xch_round",
-                 "xch_comp", "megafuse", "mega_bwd"])
+                 "xch_comp", "megafuse", "mega_bwd", "fusion_depth"])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -635,7 +644,8 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
                 gat_backend: str = "xla",
                 halo_overlap: bool = False,
                 xch: tuple = ("fp32", "nearest", "plain"),
-                megafuse: bool = False) -> ShardedGraphData:
+                megafuse: bool = False,
+                fusion_depth: int = 1) -> ShardedGraphData:
     if halo is not None:
         src = halo.edge_src_local
     else:
@@ -673,6 +683,7 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         megafuse=megafuse,
         mega_bwd=(megafuse
                   and os.environ.get("ROC_MEGA_BWD", "") != "0"),
+        fusion_depth=fusion_depth,
     )
 
 
@@ -1302,7 +1313,8 @@ class SpmdTrainer(BaseTrainer):
                 send_idx=None, plans=plans, gat_plans=gat_plans,
                 backend=backend, mode="edge",
                 precision=cfg.aggregate_precision,
-                megafuse=cfg.megafuse)
+                megafuse=cfg.megafuse,
+                fusion_depth=getattr(cfg, "fusion_depth", 1))
         if self._exchange_mode == "ring":
             from roc_tpu.parallel.ring import build_ring_groups, \
                 build_ring_plans
@@ -1323,7 +1335,8 @@ class SpmdTrainer(BaseTrainer):
                 plans=None, ring_plans=ring_plans, backend=backend,
                 mode="ring", precision=cfg.aggregate_precision,
                 xch_dtype=xd, xch_round=xr, xch_comp=xc,
-                megafuse=cfg.megafuse)
+                megafuse=cfg.megafuse,
+                fusion_depth=getattr(cfg, "fusion_depth", 1))
         if self._exchange_mode == "halo":
             with obs.span("halo_build", parts=self.part.num_parts):
                 self.halo = build_halo_maps(self.part)
@@ -1350,7 +1363,8 @@ class SpmdTrainer(BaseTrainer):
                                gat_backend=gat_backend,
                                halo_overlap=self._halo_overlap(),
                                xch=self._xch_meta(),
-                               megafuse=cfg.megafuse)
+                               megafuse=cfg.megafuse,
+                               fusion_depth=getattr(cfg, "fusion_depth", 1))
 
     def _build_graph_perhost(self, backend: str,
                              gat_backend: str = "xla") -> ShardedGraphData:
@@ -1423,7 +1437,8 @@ class SpmdTrainer(BaseTrainer):
                 send_idx=None, plans=plans, gat_plans=gat_plans,
                 backend=backend, mode="edge",
                 precision=cfg.aggregate_precision,
-                megafuse=cfg.megafuse)
+                megafuse=cfg.megafuse,
+                fusion_depth=getattr(cfg, "fusion_depth", 1))
         local = shard_load.load_local_shards(path, meta, part_ids)
         if self._exchange_mode == "ring":
             # Ring × perhost (closes a round-3 documented fallback): every
@@ -1453,7 +1468,8 @@ class SpmdTrainer(BaseTrainer):
                 plans=None, ring_plans=ring_plans, backend=backend,
                 mode="ring", precision=cfg.aggregate_precision,
                 xch_dtype=xd, xch_round=xr, xch_comp=xc,
-                megafuse=cfg.megafuse)
+                megafuse=cfg.megafuse,
+                fusion_depth=getattr(cfg, "fusion_depth", 1))
         lhalo = shard_load.build_halo_local(meta, local, ag) \
             if self._exchange_mode == "halo" else None
         self.halo = lhalo
@@ -1494,7 +1510,8 @@ class SpmdTrainer(BaseTrainer):
             backend=backend,
             precision=cfg.aggregate_precision,
             xch_dtype=xd, xch_round=xr, xch_comp=xc,
-            megafuse=cfg.megafuse)
+            megafuse=cfg.megafuse,
+            fusion_depth=getattr(cfg, "fusion_depth", 1))
 
     def _place_parts(self, gd: ShardedGraphData,
                      spec: NamedSharding) -> ShardedGraphData:

@@ -266,7 +266,7 @@ def dense_graph_data(graph, backend: str = "xla",
 
 
 def make_gctx(g: DenseGraphData, num_nodes: int,
-              megafuse: bool = False) -> GraphCtx:
+              megafuse: bool = False, fusion_depth: int = 1) -> GraphCtx:
     interp = pallas_interpret()
 
     def aggregate(x, aggr):
@@ -343,8 +343,39 @@ def make_gctx(g: DenseGraphData, num_nodes: int,
                 out = ops.apply_activation(out, activation)
             return out
 
+    fuse_region = None
+    if fuse_linear is not None and fusion_depth != 1:
+        from roc_tpu.ops.pallas import binned as _B
+
+        def fuse_region(x, ws, activations, fold=False):
+            # Trace-time legality for the whole region, all static: a
+            # None return makes model.apply fall through to the
+            # per-layer fuse_linear pass at the same op index — the
+            # exact fusion_depth=1 program (tests pin byte-identity).
+            # mega_regions only offers sum-aggregating chains, so no
+            # avg handling here; the kill switch restores PR-10
+            # per-layer behavior wholesale.
+            if _B.xlayer_killed():
+                return None
+            widths = (x.shape[-1],) + tuple(w.shape[-1] for w in ws)
+            if not _B.region_ok(g.plans.fwd, widths, g.precision,
+                                x.dtype):
+                return None
+            if fold:
+                # the region kernel owns the INTERIOR norm pairs; the
+                # head pre-scale and tail post-scale stay outside,
+                # exactly like the per-layer folded hook
+                x = ops.indegree_norm(x, g.in_degree)
+            out = ops.region_linear_binned(
+                x, tuple(ws), g.in_degree, g.plans, interp, g.precision,
+                tuple(activations), fold)
+            if fold:
+                out = ops.indegree_norm(out, g.in_degree)
+            return out
+
     return GraphCtx(aggregate=aggregate, in_degree=g.in_degree,
-                    attend=attend, fuse_linear=fuse_linear)
+                    attend=attend, fuse_linear=fuse_linear,
+                    fuse_region=fuse_region, fusion_depth=fusion_depth)
 
 
 @dataclasses.dataclass
@@ -518,6 +549,13 @@ class BaseTrainer:
             if src == "measured":
                 led.measure("peak_memory", key, hbm, "bytes",
                             epoch=int(epoch))
+                if getattr(self, "_xlayer_calib", False):
+                    # measurement half of the fusion-region peak pair
+                    # (_resolve_mem_plan): same device-reported peak,
+                    # region-specific model name so its drift is
+                    # attributable to the kept/dropped accounting
+                    led.measure("xlayer_peak_memory", key, hbm, "bytes",
+                                epoch=int(epoch))
         if self.watchdog is not None:
             alert = self.watchdog.observe_epoch(epoch, wall_s)
             if alert is not None:
@@ -632,6 +670,27 @@ class BaseTrainer:
                 if tot:
                     led.predict("hbm_bytes", self._calib_key, tot,
                                 "bytes")
+                fd = getattr(cfg, "fusion_depth", 1)
+                if fd != 1:
+                    # round-16 fusion-region pair: the cross-layer HBM
+                    # claim (hardware-counter-paired like hbm_bytes) plus
+                    # a region-aware peak prediction that DOES pair with
+                    # the device-reported peak every epoch — a drifted
+                    # kept/dropped tuple in the estimator moves this
+                    # model's ratio, which the calibration report and
+                    # watchdog EWMA then flag
+                    from roc_tpu.models.model import mega_regions
+                    regs = mega_regions(self.model, fd)
+                    xtot = sum(B.predicted_xlayer_trainstep_hbm_bytes(
+                        rows, r["members"][0]["linear"].attrs["out_dim"],
+                        len(r["members"])) for r in regs.values())
+                    if xtot:
+                        led.predict("xlayer_hbm_bytes", self._calib_key,
+                                    xtot, "bytes")
+                        led.predict("xlayer_peak_memory", self._calib_key,
+                                    self.mem_plan.predicted_peak_bytes,
+                                    "bytes")
+                        self._xlayer_calib = True
         if cfg.verbose and (cfg.mem_plan != "keep" or budget):
             print(f"# {self.mem_plan.summary()}")
 
@@ -974,6 +1033,7 @@ class Trainer(BaseTrainer):
         self._resolve_mem_plan()
         loss_fn = self._loss_fn()
         mega = self.config.megafuse
+        fdepth = getattr(self.config, "fusion_depth", 1)
         obs_on = self.config.obs
         if obs_on:
             from roc_tpu.obs import channel as obs_channel
@@ -982,7 +1042,7 @@ class Trainer(BaseTrainer):
         def train_step(params, opt_state, x, labels, mask, gdata, key, alpha,
                        gscale):
             _retrace.note_trace("train_step")
-            gctx = make_gctx(gdata, n, mega)
+            gctx = make_gctx(gdata, n, mega, fdepth)
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, x, labels, mask, gctx, key=key, train=True)
             # gscale is 1.0 on every healthy step (an exact multiply —
@@ -1008,14 +1068,14 @@ class Trainer(BaseTrainer):
         @jax.jit
         def eval_step(params, x, labels, mask, gdata):
             _retrace.note_trace("eval_step")
-            gctx = make_gctx(gdata, n, mega)
+            gctx = make_gctx(gdata, n, mega, fdepth)
             logits = model.apply(params, x, gctx, train=False)
             return ops.perf_metrics(logits, labels, mask)
 
         @jax.jit
         def logits_step(params, x, gdata):
             _retrace.note_trace("logits_step")
-            return model.apply(params, x, make_gctx(gdata, n, mega),
+            return model.apply(params, x, make_gctx(gdata, n, mega, fdepth),
                                train=False)
 
         self._train_step = train_step

@@ -25,7 +25,9 @@ runs it over the selftest sweep's output)::
        "<variant: fp32|bf16[+fuse]>": {
          "geom":      [<the full Geometry tuple, len-validated>],
          "knobs":     {"dma_cls": [...], "dimension_semantics": str,
-                       "depth": int, "mega": 0|1},
+                       "depth": int, "mega": 0|1,
+                       "fdepth": 1|2|0 (cross-layer region cap,
+                                        absent = 1 in older stores)},
          "modeled_s": <stage-0 analytic seconds>,
          "trial_s":   <winning confirmation-trial seconds>,
          "source":    "surrogate" | "device"}}}}

@@ -142,6 +142,13 @@ def modeled_seconds(cfg, stats, num_rows: int, table_rows: int,
         if fs is None:
             return float("inf"), (padded, s1, s2)
         t *= fs[0] / max(s1 + s2, 1)
+        if cfg.fdepth != 1:
+            # cross-layer region (round 16): the inter-layer [rows, H]
+            # boundary write + next layer's read never reach HBM for
+            # shard-local rows — credit one amortized boundary per fused
+            # layer.  Documented prior; device trials refit it.
+            t = max(t - 2 * num_rows * _MODEL_H * 4 / B._HBM_BW,
+                    t * 0.5)
     elif fuse_linear:
         t += (2 * num_rows * _MODEL_H * 4 / B._HBM_BW
               + -(-num_rows // 512) * chunk_s)

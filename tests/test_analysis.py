@@ -304,18 +304,31 @@ bwd_wt_ok = pl.BlockSpec((HOP, HIP), lambda i: (0, 0))   # padded: clean
 bwd_dx_bad = pl.BlockSpec((256, 41), lambda i: (i, 0))   # raw H_in: flag
 bwd_dx_ok = pl.BlockSpec((256, HIP), lambda i: (i, 0))
 bwd_g_ok = pl.BlockSpec((256, HOP), lambda i: (i, 0))    # cotangent block
+
+# cross-layer region tiles (round 16): every depth's weight rides ONE
+# stacked (D, Hm, Hm) array whose (1, Hm, Hm) BlockSpec double-buffers
+# the next depth's tile — the lane axis is still the 128-padded uniform
+# width, and the inter-layer VMEM boundary planes reuse the (SB, Hm)
+# pattern at the same padded width
+HM = 128
+xl_w_bad = pl.BlockSpec((1, HM, 41), lambda c: (c, 0, 0))  # raw lane: flag
+xl_w_sub = pl.BlockSpec((1, 12, HM), lambda c: (c, 0, 0))  # sublane 12: flag
+xl_w_ok = pl.BlockSpec((1, HM, HM), lambda c: (c, 0, 0))
+xl_b_bad = pl.BlockSpec((256, 41), lambda c: (c, 0))       # raw width: flag
+xl_b_ok = pl.BlockSpec((256, HM), lambda c: (c, 0))        # VMEM boundary
 """
 
 
 def test_mosaic_lint_flags_fixture():
     from roc_tpu.analysis import mosaic
     fs = mosaic.lint_source(_MOSAIC_FIXTURE, "<fixture>")
-    assert len(fs) == 6, fs
+    assert len(fs) == 9, fs
     assert all(f.rule == "mosaic-align" for f in fs)
     lines = sorted(f.line for f in fs)
     # the ds(0,41), two bad BlockSpecs, the raw-H_out mega weight tile,
-    # and the raw-H_in transposed weight + dx tiles
-    assert lines == [8, 13, 14, 25, 34, 36], fs
+    # the raw-H_in transposed weight + dx tiles, and the round-16
+    # stacked-weight (lane + sublane) and inter-layer boundary tiles
+    assert lines == [8, 13, 14, 25, 34, 36, 46, 47, 49], fs
 
 
 def test_mosaic_lint_waiver():
@@ -323,7 +336,7 @@ def test_mosaic_lint_waiver():
     src = _MOSAIC_FIXTURE.replace(
         "# sublane 41 % 8 != 0: flag", "# roclint: allow(mosaic-align)")
     fs = mosaic.lint_source(src, "<fixture>")
-    assert len(fs) == 5 and all(f.line > 8 for f in fs), fs
+    assert len(fs) == 8 and all(f.line > 8 for f in fs), fs
 
 
 def test_mosaic_lint_clean_on_tree():

@@ -1,0 +1,479 @@
+"""Cross-layer megakernel (round 16): whole fusion regions —
+aggregate -> linear (-> ReLU) -> aggregate -> linear ... — through ONE
+Pallas grid (ops/pallas/binned.py run_binned_region[_bwd] + the
+custom-VJP dispatch in ops/aggregate.py region_linear_binned + the
+mega_regions planner in models/model.py), in interpret mode on CPU.
+
+Bit-equality strategy mirrors tests/test_mega_bwd.py, with one twist the
+region depth adds: magnitudes COMPOUND across fused layers, and the
+in-kernel dW accumulates per chunk window while the per-layer oracle
+issues one GEMM — the associations only agree bitwise while every
+partial sum stays fp32-integer-exact (< 2^24).  A depth-3 chain cubes
+the growth, so the bitwise lanes below use small bounded integers (and
+the bf16-unit lane keeps every STAGED intermediate bf16-exact, <= 256).
+
+Relu tie rule: the region kernel masks with the replayed forward's
+``> 0``, the per-layer FUSED backward masks the saved output ``> 0`` —
+tie-consistent — but the fully-unfused replay's ``maximum`` VJP emits
+0.5*g at exact-zero pre-activations, which bounded integer data hits
+constantly (and a chained dominance construction that avoids ties blows
+the 2^24 exactness bound — the magnitudes compound per layer).  So the
+relu lanes pin the tie-consistent pair (region vs per-layer-fused), and
+the fully-unfused rung joins on the activation-free shape where the tie
+rule never fires.  tests/test_mega_bwd.py already pins per-layer-fused
+vs fully-unfused WITH relu under single-layer dominance, closing the
+triangle.
+
+The decline ladder is the contract under test as much as the kernel:
+region -> per-layer fused -> two-pass unfused, each step byte-identical
+to the program the narrower mode would have run.
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu import ops
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gcn, build_gin, build_sage
+from roc_tpu.models.model import mega_matches, mega_regions
+from roc_tpu.ops.aggregate import _unfused_region
+from roc_tpu.ops.pallas import binned as B
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+GF = B.Geometry(sb=256, ch=512, slot=128, rb=256, ch2=512, grt=1 << 14,
+                flat=1)
+GFB = GF._replace(unit=16)
+
+BASE = dict(num_epochs=3, learning_rate=0.01, weight_decay=5e-4,
+            dropout_rate=0.0, eval_every=1000)
+
+_ORIG_XL_RUN = B._xlayer_run
+_ORIG_XL_BWD_RUN = B._xlayer_bwd_run
+
+
+def _spy_region(monkeypatch):
+    """(fwd launches, bwd launches) of the REAL region kernels, so the
+    decline paths can't fake a fused pass."""
+    fwd, bwd = [], []
+    monkeypatch.setattr(
+        B, "_xlayer_run",
+        lambda *a, **k: (fwd.append(1), _ORIG_XL_RUN(*a, **k))[1])
+    monkeypatch.setattr(
+        B, "_xlayer_bwd_run",
+        lambda *a, **k: (bwd.append(1), _ORIG_XL_BWD_RUN(*a, **k))[1])
+    return fwd, bwd
+
+
+def _chain_graph(depth, seed, n=256, h=8, lo=-1, hi=1):
+    """Square integer graph + weight chain with magnitudes small enough
+    that every partial sum both paths stage or accumulate stays
+    fp32-integer-exact at this depth (module docstring).  In-degrees are
+    all powers of 4 (1 or 4), so GCN-fold's ``rsqrt(deg)`` scales are
+    EXACT powers of two — the folded lanes stay bitwise too; a general
+    degree's irrational rsqrt would expose every dW reassociation at the
+    ULP level."""
+    rng = np.random.default_rng(seed)
+    reps = np.ones(n, np.int64)
+    reps[rng.permutation(n)[:n // 4]] = 4
+    dst = np.repeat(np.arange(n, dtype=np.int64), reps)
+    e = int(dst.shape[0])
+    src = rng.integers(0, n, e).astype(np.int64)
+    x = rng.integers(0, 2, (n, h)).astype(np.float32)
+    ws = tuple(rng.integers(lo, hi + 1, (h, h)).astype(np.float32)
+               for _ in range(depth))
+    g = rng.integers(lo, hi + 1, (n, h)).astype(np.float32)
+    return src, dst, x, ws, g, jnp.asarray(reps.astype(np.float32))
+
+
+def _region_grads(src, dst, x, ws, g, deg, geom, precision, acts, fold,
+                  monkeypatch, *, oracle=None):
+    """(y, dx, dws, fwd/bwd launch lists) through the region custom VJP,
+    or through `_unfused_region` when ``oracle`` names a decline rung:
+    "perlayer" keeps the per-layer megakernels, "unfused" kills them."""
+    n = int(x.shape[0])
+    plans = ops.build_binned_plans(src, dst, n, n, geom=geom)
+    if oracle == "unfused":
+        monkeypatch.setenv("ROC_BINNED_NO_FUSE", "1")
+        monkeypatch.setenv("ROC_MEGA_BWD", "0")
+        monkeypatch.setattr(B, "_MEGA_BWD_KILL_WARNED", [True])
+    else:
+        monkeypatch.delenv("ROC_BINNED_NO_FUSE", raising=False)
+        monkeypatch.delenv("ROC_MEGA_BWD", raising=False)
+    cf, cb = _spy_region(monkeypatch)
+    if oracle is None:
+        widths = (x.shape[-1],) + tuple(w.shape[-1] for w in ws)
+        assert B.region_ok(plans.fwd, widths, precision, jnp.float32)
+        fn = lambda xx, wws: ops.region_linear_binned(
+            xx, wws, deg, plans, True, precision, acts, fold)
+    else:
+        fn = lambda xx, wws: _unfused_region(
+            xx, wws, deg, plans, True, precision, acts, fold)
+    y, vjp = jax.vjp(fn, jnp.asarray(x), ws)
+    dx, dws = vjp(jnp.asarray(g))
+    return (np.asarray(y), np.asarray(dx),
+            tuple(np.asarray(d) for d in dws), cf, cb)
+
+
+# -- region vs per-layer-fused vs fully-unfused: bitwise lanes -------------
+
+@pytest.mark.parametrize("fold", [False, True])
+@pytest.mark.parametrize("depth", [2, 3])
+def test_region_bitwise_exact_fp32(depth, fold, monkeypatch):
+    """fp32 staging at ``precision="exact"``: the fused region's forward
+    AND backward must be BIT-identical on bounded integer data at depths
+    2 and 3 (both fold shapes) — to the per-layer-fused chain with relu
+    on every interior layer (the tie-consistent pair: both mask the
+    forward output ``> 0``), and to ALL rungs including the fully-unfused
+    two-pass chain on the activation-free shape (the ``maximum`` VJP's
+    0.5*g tie rule never fires without a relu)."""
+    src, dst, x, ws, g, deg = _chain_graph(depth, seed=3 + depth)
+    relus = tuple("relu" if d < depth - 1 else "none"
+                  for d in range(depth))
+    for acts, rungs in (((("none",) * depth), ("perlayer", "unfused")),
+                        (relus, ("perlayer",))):
+        yf, dxf, dwsf, cf, cb = _region_grads(
+            src, dst, x, ws, g, deg, GF, "exact", acts, fold, monkeypatch)
+        assert cf and cb, "region kernel fell back"
+        for rung in rungs:
+            yr, dxr, dwsr, cf2, cb2 = _region_grads(
+                src, dst, x, ws, g, deg, GF, "exact", acts, fold,
+                monkeypatch, oracle=rung)
+            assert not cf2 and not cb2
+            np.testing.assert_array_equal(yf, yr)
+            np.testing.assert_array_equal(dxf, dxr)
+            for a, b in zip(dwsf, dwsr):
+                np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_region_bitwise_fast_bf16_unit(depth, monkeypatch):
+    """bf16 16-row staging unit at ``precision="fast"``: the same bitwise
+    rung ladder while every staged intermediate stays bf16-exact — the
+    bounded construction keeps row sums under 256."""
+    src, dst, x, ws, g, deg = _chain_graph(depth, seed=7 + depth)
+    relus = tuple("relu" if d < depth - 1 else "none"
+                  for d in range(depth))
+    for acts, rungs in (((("none",) * depth), ("perlayer", "unfused")),
+                        (relus, ("perlayer",))):
+        yf, dxf, dwsf, cf, cb = _region_grads(
+            src, dst, x, ws, g, deg, GFB, "fast", acts, False, monkeypatch)
+        assert cf and cb
+        for rung in rungs:
+            yr, dxr, dwsr, _, _ = _region_grads(
+                src, dst, x, ws, g, deg, GFB, "fast", acts, False,
+                monkeypatch, oracle=rung)
+            np.testing.assert_array_equal(yf, yr)
+            np.testing.assert_array_equal(dxf, dxr)
+            for a, b in zip(dwsf, dwsr):
+                np.testing.assert_array_equal(a, b)
+
+
+def test_region_exact_ulp_bound_continuous(monkeypatch):
+    """Continuous data at ``precision="exact"``, depth 2: the region's
+    add reassociation (per-chunk in-kernel dW vs the oracle's GEMMs)
+    stays within 32 normalized ULPs (abs diff / (eps * row max))."""
+    n, e, h = 512, 3000, 64
+    rng = np.random.default_rng(11)
+    src = rng.integers(0, n, e).astype(np.int64)
+    dst = np.sort(np.concatenate([np.arange(n, dtype=np.int64),
+                                  rng.integers(0, n, e - n)]))
+    x = rng.standard_normal((n, h)).astype(np.float32)
+    ws = tuple(jnp.asarray(rng.standard_normal((h, h)).astype(np.float32))
+               for _ in range(2))
+    g = rng.standard_normal((n, h)).astype(np.float32)
+    deg = np.zeros(n, np.float32)
+    np.add.at(deg, dst, 1.0)
+    deg = jnp.asarray(np.maximum(deg, 1.0))
+    acts = ("relu", "none")
+    yf, dxf, dwsf, cf, cb = _region_grads(src, dst, x, ws, g, deg, GF,
+                                          "exact", acts, False, monkeypatch)
+    assert cf and cb
+    yr, dxr, dwsr, _, _ = _region_grads(src, dst, x, ws, g, deg, GF,
+                                        "exact", acts, False, monkeypatch,
+                                        oracle="perlayer")
+    eps = np.finfo(np.float32).eps
+
+    def nulp(a, b):
+        scale = np.maximum(np.abs(b).max(axis=-1, keepdims=True), 1e-30)
+        return float((np.abs(a - b) / (eps * scale)).max())
+
+    assert nulp(yf, yr) <= 32.0
+    assert nulp(dxf, dxr) <= 32.0
+    for a, b in zip(dwsf, dwsr):
+        assert nulp(a, b) <= 32.0
+
+
+# -- the mega_regions planner (static op-IR grammar) -----------------------
+
+def test_mega_regions_chain_grammar():
+    """Residual-free deep GCN: layers 0..L-2 chain (the logits layer
+    never joins), depth caps bite, depth 1 disables, and the region's
+    skip/gone sets cover exactly the replaced interior."""
+    m = build_gcn([64, 16, 16, 16, 8], 0.0, residual=False)
+    assert set(mega_matches(m)) == {1, 7, 13, 19}   # stride 6: no residual
+    full = mega_regions(m, 0)
+    assert set(full) == {1}
+    assert len(full[1]["members"]) == 3          # logits layer stays out
+    assert full[1]["fold"] is True
+    capped = mega_regions(m, 2)
+    assert [len(r["members"]) for _, r in sorted(capped.items())] == [2]
+    assert mega_regions(m, 1) == {}
+    # the dispatch head survives, everything else the region replaces is
+    # skipped, and the interior boundaries are the dropped tensors
+    r = capped[1]
+    assert 1 not in r["skip"]
+    assert r["final"].out not in r["gone"]       # region OUTPUT survives
+    assert all(t != m.logits.id for t in r["gone"])
+
+
+def test_mega_regions_residual_and_mlp_break_chains():
+    """The deep-GCN residual ``add`` pins every layer boundary (no
+    regions), and GIN's second MLP linear is not an admissible
+    interstitial — per-layer matches stay available either way."""
+    assert mega_regions(build_gcn([64, 16, 16, 8], 0.0), 0) == {}
+    assert mega_regions(build_gin([64, 16, 16, 8], 0.0), 0) == {}
+    assert mega_matches(build_gin([64, 16, 16, 8], 0.0))
+
+
+def test_mega_regions_sage_avg_ineligible():
+    """SAGE aggregates with avg: the divide-by-degree runs outside any
+    kernel, so no member is region-eligible — the decline path."""
+    assert mega_regions(build_sage([64, 16, 16, 8], 0.0), 0) == {}
+
+
+def test_mega_regions_deterministic():
+    """Same builder config -> byte-identical region partition (the
+    preflight determinism gate's in-process half)."""
+    def plan():
+        regs = mega_regions(build_gcn([64, 16, 16, 16, 8], 0.0,
+                                      residual=False), 0)
+        return json.dumps(
+            {str(k): {"depth": len(r["members"]), "fold": r["fold"],
+                      "skip": list(r["skip"]), "gone": list(r["gone"])}
+             for k, r in regs.items()}, sort_keys=True)
+    assert plan() == plan()
+
+
+def test_estimator_prices_region_kept_dropped():
+    """Memory-planner honesty (satellite): the estimator consumes the
+    region's kept/dropped tuple — inter-layer boundaries inside a fusion
+    region price to zero bytes shard-locally, the halo frontier's rows
+    survive, and the region OUTPUT boundary stays fully priced."""
+    from roc_tpu.memory.estimator import estimate_model
+    m = build_gcn([64, 16, 16, 16, 8], 0.0, residual=False)
+    rows, edges, h = 1000, 5000, 16
+    e1 = estimate_model(m, rows, edges, megafuse=True, fusion_depth=1)
+    e2 = estimate_model(m, rows, edges, megafuse=True, fusion_depth=2)
+    e0 = estimate_model(m, rows, edges, megafuse=True, fusion_depth=0)
+    # monotone in depth: each extra fused boundary drops [rows, h] bytes
+    assert e1.total_full_bytes() > e2.total_full_bytes() \
+        > e0.total_full_bytes()
+    # the full region (3 members) hides 2 interior boundaries; the
+    # region-output boundary (layer 2) and logits layer keep full price
+    b1 = [l.bytes_boundary for l in e1.layers]
+    b0 = [l.bytes_boundary for l in e0.layers]
+    assert b0[0] == 0 and b0[1] == 0
+    assert b0[2] == b1[2] and b0[3] == b1[3]
+    # halo frontier survives: each hidden interior boundary re-prices at
+    # [K, h] — twice per boundary, for the activation output AND its
+    # pass-through (rate-0) dropout view, both region-dropped tensors
+    halo = 64
+    eh = estimate_model(m, rows, edges, megafuse=True, fusion_depth=0,
+                        halo_rows=halo)
+    assert eh.total_full_bytes() - e0.total_full_bytes() \
+        == 2 * 2 * halo * h * 4
+    assert [l.bytes_boundary for l in eh.layers][0] == halo * h * 4
+
+
+# -- kill switch + VMEM gate decline ladder --------------------------------
+
+def test_xlayer_kill_switch_warns_once_and_disables(monkeypatch):
+    monkeypatch.setattr(B, "_XLAYER_KILL_WARNED", [False])
+    monkeypatch.setenv("ROC_XLAYER", "0")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert B.xlayer_killed()
+        assert B.xlayer_killed()
+    assert sum("ROC_XLAYER" in str(r.message) for r in rec) == 1
+    src, dst, x, ws, g, deg = _chain_graph(2, seed=5)
+    plans = ops.build_binned_plans(src, dst, 256, 256, geom=GF)
+    widths = (8, 8, 8)
+    assert not B.region_ok(plans.fwd, widths, "exact", jnp.float32)
+    monkeypatch.delenv("ROC_XLAYER")
+    monkeypatch.setattr(B, "_XLAYER_KILL_WARNED", [False])
+    assert not B.xlayer_killed()
+    assert B.region_ok(plans.fwd, widths, "exact", jnp.float32)
+
+
+def _mega_ds():
+    return datasets.get("mega-shard", seed=1)
+
+
+def _xlayer_trainstep(build, fdepth, monkeypatch, expect_region):
+    """One 3-epoch driver leg at the mega-shard shape: returns (logits,
+    loss) with the region kernels' launch counts asserted."""
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    monkeypatch.delenv("ROC_XLAYER", raising=False)
+    monkeypatch.delenv("ROC_MEGA_BWD", raising=False)
+    ds = _mega_ds()
+    layers = [ds.in_dim, 16, 16, ds.num_classes]
+    cfg = Config(layers=layers, **BASE, aggregate_backend="binned",
+                 aggregate_precision="exact", megafuse=True,
+                 fusion_depth=fdepth)
+    tr = Trainer(cfg, ds, build(layers))
+    cf, cb = _spy_region(monkeypatch)
+    tr.train(print_fn=lambda *a, **k: None)
+    assert bool(cf) == expect_region and bool(cb) == expect_region
+    logits = np.asarray(tr._logits_step(tr.params, tr.x, tr.gdata))
+    loss = float(ops.masked_softmax_cross_entropy(
+        jnp.asarray(logits), tr.labels, tr.mask))
+    return logits, loss
+
+
+def test_gcn_norm_folded_region_trainstep_parity(monkeypatch):
+    """Residual-free GCN, norm-folded: 3 training epochs with the region
+    forward AND backward land within 1e-3 of the per-layer-fused
+    (fusion_depth=1) leg on logits and loss (measured ~5e-7 exact)."""
+    build = lambda layers: build_gcn(layers, 0.0, residual=False)
+    base = _xlayer_trainstep(build, 1, monkeypatch, expect_region=False)
+    for fd in (2, 0):
+        got = _xlayer_trainstep(build, fd, monkeypatch, expect_region=True)
+        np.testing.assert_allclose(got[0], base[0], atol=1e-3)
+        assert abs(got[1] - base[1]) <= 1e-3
+
+
+def test_sage_decline_is_byte_identical(monkeypatch):
+    """SAGE (avg lane): mega_regions offers nothing, so fusion_depth=2
+    must run the EXACT fusion_depth=1 program — logits byte-identical,
+    zero region launches."""
+    build = lambda layers: build_sage(layers, 0.0)
+    base = _xlayer_trainstep(build, 1, monkeypatch, expect_region=False)
+    got = _xlayer_trainstep(build, 2, monkeypatch, expect_region=False)
+    np.testing.assert_array_equal(got[0], base[0])
+    assert got[1] == base[1]
+
+
+def test_region_vmem_gate_falls_back_to_depth1_byte_identical(monkeypatch):
+    """A region that fails its VMEM gate must fall through to the
+    per-layer pass — byte-identical logits, zero region launches."""
+    assert not B._xlayer_vmem_ok(GF, B._pad_to(16384, 128), 3, 2)
+    build = lambda layers: build_gcn(layers, 0.0, residual=False)
+    base = _xlayer_trainstep(build, 1, monkeypatch, expect_region=False)
+    monkeypatch.setattr(B, "_xlayer_vmem_ok", lambda *a, **k: False)
+    got = _xlayer_trainstep(build, 2, monkeypatch, expect_region=False)
+    np.testing.assert_array_equal(got[0], base[0])
+    assert got[1] == base[1]
+
+
+def test_xlayer_kill_switch_restores_per_layer_program(monkeypatch):
+    """ROC_XLAYER=0 with fusion_depth=2 runs the PR-10 per-layer program
+    byte for byte (the wholesale kill switch the round promises)."""
+    build = lambda layers: build_gcn(layers, 0.0, residual=False)
+    base = _xlayer_trainstep(build, 1, monkeypatch, expect_region=False)
+    monkeypatch.setenv("ROC_XLAYER", "0")
+    monkeypatch.setattr(B, "_XLAYER_KILL_WARNED", [True])
+    ds = _mega_ds()
+    layers = [ds.in_dim, 16, 16, ds.num_classes]
+    cfg = Config(layers=layers, **BASE, aggregate_backend="binned",
+                 aggregate_precision="exact", megafuse=True, fusion_depth=2)
+    tr = Trainer(cfg, ds, build(layers))
+    cf, cb = _spy_region(monkeypatch)
+    tr.train(print_fn=lambda *a, **k: None)
+    assert not cf and not cb
+    logits = np.asarray(tr._logits_step(tr.params, tr.x, tr.gdata))
+    np.testing.assert_array_equal(logits, base[0])
+
+
+# -- budget pins -----------------------------------------------------------
+
+def test_xlayer_budget_rows_pin():
+    """Acceptance pin: predicted train-step HBM PER LAYER of a depth-2
+    region at the Reddit GCN shape is <= 0.5x the per-layer mega+bwd
+    number of record (PR 10's 134.5 MB), and the committed
+    ``megakernel_xlayer`` budget rows carry exactly these numbers."""
+    n, h = 32768, 256
+    perlayer = B.predicted_trainstep_hbm_bytes(n, h, h, mega_bwd=True)
+    for depth in (2, 3):
+        region = B.predicted_xlayer_trainstep_hbm_bytes(n, h, depth)
+        assert region <= 0.5 * depth * perlayer
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "kernel_budgets.json")
+    data = json.load(open(path))
+    r = data["reddit_scaled"]["megakernel_xlayer"]
+    assert r["hbm_trainstep_bytes_perlayer"] == perlayer
+    assert r["hbm_trainstep_bytes_xlayer_d2"] == \
+        B.predicted_xlayer_trainstep_hbm_bytes(n, h, 2)
+    m = data["mega_shard_scaled"]["megakernel_xlayer"]
+    assert m["hbm_trainstep_bytes_xlayer_d2"] == \
+        B.predicted_xlayer_trainstep_hbm_bytes(1024, h, 2)
+
+
+# -- retrace + step-cache keying -------------------------------------------
+
+def test_zero_retraces_with_region_active(monkeypatch):
+    """Steady-state retrace proof with the region active: fusion depth is
+    trace-time static, so epochs 2..N re-enter the same jitted step."""
+    from roc_tpu.analysis.retrace import RetraceGuard
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    monkeypatch.delenv("ROC_XLAYER", raising=False)
+    ds = _mega_ds()
+    layers = [ds.in_dim, 16, 16, ds.num_classes]
+    cfg = Config(layers=layers, **BASE, aggregate_backend="binned",
+                 megafuse=True, fusion_depth=2)
+    tr = Trainer(cfg, ds, build_gcn(layers, 0.0, residual=False))
+    cf, cb = _spy_region(monkeypatch)
+    with RetraceGuard(warmup=1) as g:
+        tr.train(print_fn=lambda *a, **k: None)
+        assert g.counts["train_step"] >= 1
+    assert cf and cb
+
+
+def test_sharded_step_cache_keys_on_fusion_depth(monkeypatch):
+    """fusion_depth rides ShardedGraphData as STATIC metadata: changing
+    the cap changes tree_structure(gd), so the step cache can never serve
+    a program traced at another region depth."""
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    ds = _mega_ds()
+    layers = [ds.in_dim, 8, ds.num_classes]
+
+    def make(fd):
+        return SpmdTrainer(Config(layers=layers, **BASE, num_parts=4,
+                                  halo=True, megafuse=True,
+                                  fusion_depth=fd),
+                           ds, build_gcn(layers, 0.0))
+
+    t1, t2 = make(1), make(2)
+    assert t1.gdata.fusion_depth == 1
+    assert t2.gdata.fusion_depth == 2
+    assert jax.tree_util.tree_structure(t1.gdata) != \
+        jax.tree_util.tree_structure(t2.gdata)
+
+
+def test_spmd_zero_retraces_and_reshard_with_fusion_depth(monkeypatch):
+    """3 sharded epochs + a same-cut reshard with fusion_depth=2 threaded
+    through ShardedGraphData: the step cache returns the SAME jitted
+    callables and nothing re-traces."""
+    from roc_tpu.analysis.retrace import RetraceGuard
+    from roc_tpu.parallel.spmd import SpmdTrainer
+    ds = _mega_ds()
+    layers = [ds.in_dim, 8, ds.num_classes]
+    tr = SpmdTrainer(Config(layers=layers, **BASE, num_parts=4, halo=True,
+                            megafuse=True, fusion_depth=2),
+                     ds, build_gcn(layers, 0.0))
+    with RetraceGuard(warmup=1) as g:
+        tr.train(print_fn=lambda *a, **k: None)
+        assert g.counts["train_step"] >= 1
+        snap = g.snapshot()
+        step_ids = (id(tr._train_step), id(tr._eval_step))
+        tr.reshard(tr.part.bounds)           # same cut, same shapes
+        assert (id(tr._train_step), id(tr._eval_step)) == step_ids
+        g.arm()
+        tr.run_epoch()
+        g.assert_no_new_traces(snap)

@@ -42,6 +42,16 @@ transposed aggregation + three GEMM sweeps, gated at the same 0.85x at
 (forward-only vs fwd+bwd fusion) pinned at >= 2x drop at the Reddit
 shape (binned.predicted_trainstep_hbm_bytes).
 
+Cross-layer rows (round 16): every shape carries a ``megakernel_xlayer``
+entry — the fusion-region forward/backward grid-step counts at depths 2
+and full (the forward grid is depth * the per-layer fused step count;
+the backward adds the (depth-1)-sweep forward replay), plus predicted
+TRAIN-STEP HBM bytes for a depth-2 and depth-3 region
+(binned.predicted_xlayer_trainstep_hbm_bytes).  check_xlayer_claim gates
+the round's acceptance claim: the region's per-layer share of predicted
+train-step HBM at the Reddit GCN shape must be <= 0.5x PR 10's per-layer
+mega+bwd number (the >= 2x cut of record, docs/PERF.md round 16).
+
     python tools/check_kernel_budgets.py            # diff, exit 1 on drift
     python tools/check_kernel_budgets.py --update   # regenerate the table
 """
@@ -94,6 +104,12 @@ MEGA_H = 256
 # cotangent round trips dominate; binned.predicted_trainstep_hbm_bytes).
 MEGA_BWD_MIN_DROP = 2.0
 
+# Max allowed (xlayer train-step HBM / depth) / per-layer-mega+bwd ratio at
+# the Reddit shape (round-16 acceptance: a fusion region must at least
+# halve the per-layer train-step traffic again vs PR 10's fused layer —
+# the inter-layer boundary and u/mask round trips it drops dominate).
+XLAYER_MAX_RATIO = 0.5
+
 
 def _geometries():
     import roc_tpu.ops.pallas.binned as B
@@ -131,8 +147,82 @@ def compute_table():
             }
         entry["megakernel"] = _mega_entry(src, dst, n, e)
         entry["megakernel_bwd"] = _mega_bwd_entry(src, dst, n, e)
+        entry["megakernel_xlayer"] = _xlayer_entry(src, dst, n, e)
         table[name] = entry
     return table
+
+
+def _xlayer_entry(src, dst, n, e):
+    """Cross-layer fusion-region row (round 16).  Step counts are exact
+    grid sizes: the region forward runs depth sweeps of the per-layer
+    fused schedule (one per fused layer, the inter-layer hand-off staying
+    in VMEM); the region backward runs (depth-1) forward-replay sweeps
+    plus depth transposed-plan sweeps, each the fused step count of its
+    plan.  HBM pins use binned.predicted_xlayer_trainstep_hbm_bytes at
+    H=MEGA_H (uniform hidden width — the GCN chain shape)."""
+    import roc_tpu.ops.pallas.binned as B
+    out = {
+        "hbm_trainstep_bytes_perlayer":
+            int(B.predicted_trainstep_hbm_bytes(n, MEGA_H, MEGA_H,
+                                                mega_bwd=True)),
+        "hbm_trainstep_bytes_xlayer_d2":
+            int(B.predicted_xlayer_trainstep_hbm_bytes(n, MEGA_H, 2)),
+        "hbm_trainstep_bytes_xlayer_d3":
+            int(B.predicted_xlayer_trainstep_hbm_bytes(n, MEGA_H, 3)),
+    }
+    for gname, geom in [("flat", B.GEOM_FLAT),
+                        ("flat_bf16", B.GEOM_FLAT_BF16)]:
+        cbf, cnf, cntf = B._cell_stats(src, dst, geom.sb, geom.rb)
+        cbb, cnb, cntb = B._cell_stats(dst, src, geom.sb, geom.rb)
+        row = {"attaches": False}
+        rf = B._fused_sched_stats(cbf, cnf, cntf, geom, n, n, e)
+        rb = B._fused_sched_stats(cbb, cnb, cntb, geom, n, n, e)
+        if rf is not None and rb is not None:
+            sf, c2f, gf = rf
+            sb, c2b, gb = rb
+            tp = -(-n // max(geom.sb, geom.rb)) * max(geom.sb, geom.rb)
+            row.update({
+                "attaches": True,
+                "xlayer_fwd_steps_d2": int(2 * sf),
+                "xlayer_bwd_steps_d2": int(sf + 2 * sb),
+                "vmem_ok_h128_d2": bool(
+                    B._xlayer_vmem_ok(geom, 128, max(c2f, c2b), 2,
+                                      groups=max(gf, gb), tp=tp)
+                    and B._xlayer_bwd_vmem_ok(geom, 128, max(c2f, c2b), 2,
+                                              groups=max(gf, gb), tp=tp,
+                                              relu_last=True)),
+            })
+        out[gname] = row
+    return out
+
+
+def check_xlayer_claim(table):
+    problems = []
+    r = table["reddit_scaled"]["megakernel_xlayer"]
+    perlayer = r["hbm_trainstep_bytes_perlayer"]
+    for depth, key in ((2, "hbm_trainstep_bytes_xlayer_d2"),
+                       (3, "hbm_trainstep_bytes_xlayer_d3")):
+        share = r[key] / depth
+        if share > XLAYER_MAX_RATIO * perlayer:
+            problems.append(
+                f"xlayer HBM claim: depth-{depth} region's per-layer "
+                f"train-step share {share:.0f} B > {XLAYER_MAX_RATIO}x "
+                f"per-layer mega+bwd {perlayer} B at reddit_scaled")
+    m = table["mega_shard_scaled"]["megakernel_xlayer"]
+    for gname in ("flat", "flat_bf16"):
+        if not m[gname]["attaches"]:
+            problems.append(f"fusion region no longer attaches at "
+                            f"mega_shard_scaled ({gname})")
+    # Like the per-layer mega gate: bf16 staging is the configuration the
+    # region must keep running at this shape; fp32 staging pricing the
+    # depth-2 backward working set past the budget is the expected
+    # composition story (the row records the honest False).
+    if (m["flat_bf16"]["attaches"]
+            and not m["flat_bf16"]["vmem_ok_h128_d2"]):
+        problems.append("fusion-region VMEM gate rejects bf16 staging at "
+                        "H=128 depth 2 at mega_shard_scaled — the region "
+                        "never runs")
+    return problems
 
 
 def _mega_entry(src, dst, n, e):
@@ -297,7 +387,7 @@ def main(argv=None) -> int:
     update = "--update" in argv
     table = compute_table()
     problems = (check_flat_claim(table) + check_mega_claim(table)
-                + check_mega_bwd_claim(table))
+                + check_mega_bwd_claim(table) + check_xlayer_claim(table))
     if update:
         if problems:
             for p in problems:

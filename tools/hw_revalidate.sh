@@ -200,6 +200,23 @@ for mf in "" "-megafuse"; do
         -dataset mega-shard -layers 64-128-8 -model gcn \
         -aggr-backend binned -e 10 $mf -v 2>&1 | tail -2 | tee -a "$LOG"
 done
+
+note "4d. cross-layer fusion-region FULL TRAIN-STEP A/B (round 16): the"
+note "    residual-free deep GCN chain (gcn-chain) at three region caps,"
+note "    same seed — depth 1 (per-layer fusion, the PR-10 program),"
+note "    depth 2 (two-layer regions), full (the whole hidden stack in"
+note "    one grid).  The -v losses must agree to ~1e-3 across all three;"
+note "    depth 2 vs 1 isolates the first inter-layer boundary's HBM"
+note "    round trip, full vs 2 the rest (kernel_budgets.json"
+note "    megakernel_xlayer predicts a depth-2 region at <= 0.51x the"
+note "    per-layer mega+bwd train-step HBM per layer at the Reddit"
+note "    shape).  Record all three epoch times in docs/PERF.md round 16."
+for fd in 1 2 0; do
+    ROC_BINNED_GEOM=flat timeout 900 python -m roc_tpu \
+        -dataset mega-shard -layers 64-128-128-8 -model gcn-chain \
+        -aggr-backend binned -e 10 -megafuse -fusion-depth $fd -v 2>&1 \
+        | tail -2 | tee -a "$LOG"
+done
 fi
 
 if [ "$START" -le 5 ]; then
