@@ -143,6 +143,12 @@ _RAW_TIMING_EXEMPT_DIR = os.path.join("roc_tpu", "obs") + os.sep
 # but a per-request .item() or np.asarray() inside the window still
 # serializes the batch it was built to amortize).
 _SERVE_DIR = os.path.join("roc_tpu", "serve") + os.sep
+# The fleet (roc_tpu/fleet/) rides the same serving hot path — its
+# router sits BETWEEN clients and the microbatch window, so a stray
+# sync there serializes every replica's batch at once.  Sanctioned
+# sites (router ingress id coercion, egress result hand-off) carry
+# documented waivers.
+_FLEET_DIR = os.path.join("roc_tpu", "fleet") + os.sep
 _SERVE_SYNC_CALLS = _HOST_SYNC_FNS | {
     "np.asarray", "np.array", "numpy.asarray", "numpy.array",
 }
@@ -312,8 +318,10 @@ class _FileLint:
                            f"dropping it is correct")
 
     def _rule_serve_sync(self):
-        """Sync-shaped calls in roc_tpu/serve/ (see _SERVE_DIR note)."""
-        if _SERVE_DIR not in self.path.replace("/", os.sep):
+        """Sync-shaped calls in roc_tpu/serve/ and roc_tpu/fleet/ (see
+        the _SERVE_DIR / _FLEET_DIR notes)."""
+        p = self.path.replace("/", os.sep)
+        if _SERVE_DIR not in p and _FLEET_DIR not in p:
             return
         for node in ast.walk(self.tree):
             if not isinstance(node, ast.Call):

@@ -35,7 +35,13 @@ Registered sites (grep for ``fault.point``): ``lux.read``,
 ``delta.journal.kill_fsync``, ``delta.journal.kill_ack``,
 ``delta.replan.slow``, ``delta.swap.kill_pre``, ``delta.swap.kill_post``,
 ``delta.ckpt.write``, ``delta.ckpt.kill_tmp``, ``delta.ckpt.kill_rename``,
-``delta.ckpt.kill_snap``.
+``delta.ckpt.kill_snap``, and the serving-fleet family
+(roc_tpu/fleet/): ``fleet.ship`` (transient, retried),
+``fleet.ship.kill_pre``, ``fleet.ship.kill_post`` (either side of a
+segment publish), ``fleet.replay.kill_mid`` (a follower dying between
+records of one segment), ``fleet.snap.kill_install`` (mid
+snapshot-install on a catching-up replica), ``fleet.replica.kill``
+(seeded whole-replica death in the selftest drill).
 
 stdlib-only on purpose: ``graph/lux.py`` (numpy + stdlib) imports this.
 """

@@ -1,0 +1,37 @@
+"""Replicated serving fleet: WAL-shipped delta replication behind a
+backpressure-aware router.
+
+The PR 15 delta write-ahead journal *is* a replication log — this
+package just ships it.  One primary ServeEngine owns the delta write
+path; its journal tail is sealed into CRC-framed segments
+(:mod:`roc_tpu.fleet.replog`) and published over a transport (in-proc
+deque, spool directory, or localhost TCP) to follower replicas
+(:mod:`roc_tpu.fleet.replica`) that replay the records through the very
+classify/patch path the primary ran — deterministic classification
+keeps every member in bitwise seq-lockstep.  Queries are dispatched by a
+least-loaded, freshness-floored router (:mod:`roc_tpu.fleet.router`)
+that turns per-replica overload into typed fleet-wide backpressure and
+drives an autoscale hook off the watchdog EWMAs.
+
+``python -m roc_tpu.fleet --selftest`` is the preflight drill: 3
+replicas, a mixed query+delta stream, one seeded replica kill, parity
+and catch-up pinned.
+"""
+
+from roc_tpu.fleet.replica import Replica
+from roc_tpu.fleet.replog import (FileTransport, InProcTransport,
+                                  ReplicationError, ReplicationLog,
+                                  SegmentGapError, SegmentRotError,
+                                  SocketTransport, TornSegmentError,
+                                  Transport, decode_segment,
+                                  encode_segment, install_snapshot_files,
+                                  replay_segment)
+from roc_tpu.fleet.router import FleetOverloaded, FleetRouter
+
+__all__ = [
+    "Replica", "ReplicationLog", "Transport", "InProcTransport",
+    "FileTransport", "SocketTransport", "encode_segment",
+    "decode_segment", "replay_segment", "install_snapshot_files",
+    "ReplicationError", "TornSegmentError", "SegmentGapError",
+    "SegmentRotError", "FleetRouter", "FleetOverloaded",
+]

@@ -1,0 +1,252 @@
+"""`python -m roc_tpu.fleet --selftest`: the replicated-serving drill.
+
+End-to-end on CPU with the tiny audit graph (preflight's fleet step):
+warm the content-keyed plan cache, then stand up a 3-replica fleet
+(primary + 2 followers on in-proc transports) behind the router and pin
+the fleet contracts in one process:
+
+  1. every replica cold-starts from the warm cache with ZERO plan
+     rebuilds (cache read + one trace each),
+  2. a 1000-event mixed query+delta stream keeps all replicas in seq
+     lockstep with ZERO retraces and ZERO plan rebuilds after warmup,
+  3. a seeded hard kill (``fleet.replica.kill``) of one follower
+     mid-stream never loses an acked delta: the router keeps answering
+     on the survivors, and the restarted replica replays its local WAL
+     then catches the missed records up through the snapshot protocol
+     (checkpoint + truncated journal + tail segments),
+  4. every replica's served logits match a single delta-enabled
+     ServeEngine oracle fed the exact same deltas, bitwise (0 ULPs),
+  5. backpressure is typed and visible: deadline-expired requests and
+     fleet sheds are counted in ``router.stats()``, never silent.
+
+Exit 0 with a one-line summary per contract; any violation raises.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import warnings
+
+
+def selftest() -> int:
+    tmp = tempfile.mkdtemp(prefix="roc_fleet_selftest_")
+    os.environ["ROC_PLAN_CACHE_DIR"] = os.path.join(tmp, "plan_cache")
+    os.environ["ROC_PLAN_CACHE_MIN_EDGES"] = "0"
+
+    import numpy as np
+
+    from roc_tpu.fault import SimulatedCrash, inject
+    from roc_tpu.fleet import (FleetRouter, InProcTransport, Replica,
+                               ReplicationLog)
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_model
+    from roc_tpu.obs.watchdog import PerfWatchdog
+    from roc_tpu.ops.pallas import binned as _B
+    from roc_tpu.serve import ServeEngine, max_ulp_diff
+    from roc_tpu.serve.queue import Overloaded
+    from roc_tpu.train import checkpoint
+    from roc_tpu.train.config import Config
+    from roc_tpu.train.driver import make_trainer
+
+    cfg = Config(dataset="roc-audit", layers=[8, 16, 4], num_epochs=2,
+                 aggregate_backend="binned", serve_batch=8,
+                 serve_wait_ms=1.0)
+    ds = datasets.get(cfg.dataset, seed=cfg.seed)
+    model = build_model(cfg.model, cfg.layers, cfg.dropout_rate, cfg.aggr,
+                        heads=cfg.heads)
+
+    # -- warm: train briefly so every cold start below is a cache read
+    trainer = make_trainer(cfg, ds, model)
+    trainer.train()
+    ckpt = os.path.join(tmp, "fleet.ckpt.npz")
+    checkpoint.save(ckpt, trainer.params, trainer.opt_state, trainer.epoch,
+                    trainer.optimizer.alpha)
+    del trainer
+
+    wd = PerfWatchdog()
+    n = ds.graph.num_nodes
+    all_ids = np.arange(n, dtype=np.int32)
+
+    def make_replica(name):
+        return Replica(name, cfg, ds, model, ckpt,
+                       os.path.join(tmp, f"{name}.wal"), watchdog=wd)
+
+    primary = make_replica("primary")
+    followers = [make_replica("follower-1"), make_replica("follower-2")]
+    replog = ReplicationLog(primary.engine)
+    for rep in followers:
+        rep.transport = replog.attach(InProcTransport())
+    router = FleetRouter(primary, followers, replog, freshness_floor=0,
+                         max_retries=1, watchdog=wd)
+    # the oracle: ONE delta-enabled engine (volatile journal — same
+    # two-pass unfused execution as the fleet members) fed every delta
+    oracle = ServeEngine(cfg, ds, model, checkpoint_path=ckpt,
+                         delta_journal="")
+    builds0 = _B.plan_build_count()
+
+    for rep in router.replicas:
+        cs = rep.engine.cold_start_stats
+        assert cs["plan_builds"] == 0, (
+            f"{rep.name} cold start rebuilt {cs['plan_builds']} plan(s); "
+            f"the shared warm plan cache must make every fleet cold "
+            f"start a cache read")
+    print(f"# fleet selftest: 3 replicas cold-started from the shared "
+          f"plan cache, plan_builds=0 each")
+
+    # -- warmup + retrace baselines for the members that live all drill
+    for eng in (primary.engine, followers[0].engine, oracle):
+        eng.warmup()
+    # trace notes are global across engines, so ONE guard's baseline
+    # covers the whole process; keyed by drill window
+    guards = {"primary": primary.engine._guard.snapshot()}
+
+    # -- 1000-event mixed stream with a seeded kill window ------------------
+    rng = np.random.default_rng(17)
+    added: list = []
+    deltas = 0
+    answered = 0
+    fleet_shed = 0
+    kill_at, restart_at = 400, 700
+    seq_at_kill = None
+
+    def one_delta():
+        nonlocal deltas
+        if added and (len(added) >= 12 or rng.random() < 0.4):
+            rets = np.stack([added.pop(0), added.pop(0)], 0)
+            adds = None
+        else:
+            adds = rng.integers(0, n, (2, 2))
+            added.extend(list(adds))
+            rets = None
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            router.apply_delta(adds, rets)   # primary + pump to followers
+            oracle.apply_delta(adds, rets)   # same batch, single engine
+        deltas += 1
+
+    for event in range(1000):
+        if event == kill_at:
+            # seeded hard kill: no graceful drain, transport lost too
+            inject.configure("fleet.replica.kill=1")
+            try:
+                followers[1].kill()
+                raise AssertionError("armed kill site did not fire")
+            except SimulatedCrash:
+                pass  # roclint: allow(silent-swallow) — the crash IS the drill
+            finally:
+                inject.configure("")
+            seq_at_kill = primary.applied_seq
+            replog.detach(followers[1].transport)
+            print(f"# fleet selftest: follower-2 hard-killed at event "
+                  f"{kill_at} (seq {seq_at_kill}); serving continues on "
+                  f"{len(router.eligible())} replicas")
+            # deltas keep landing while it is down — the records it will
+            # have to catch up on through the snapshot protocol
+            for _ in range(3):
+                one_delta()
+        if event == restart_at:
+            # the kill window itself must not have retraced any survivor
+            # (trace notes are GLOBAL across engines, so one guard's
+            # baseline diff covers the whole process up to this point)
+            primary.engine._guard.assert_no_new_traces(guards["primary"])
+            followers[1].restart()
+            assert followers[1].applied_seq == seq_at_kill, (
+                f"restart should replay the local WAL exactly to the "
+                f"kill-time watermark {seq_at_kill}, got "
+                f"{followers[1].applied_seq}")
+            followers[1].transport = replog.attach(InProcTransport())
+            head = primary.applied_seq
+            applied = router.pump()   # gap -> snapshot catch-up, in-line
+            assert router.catch_ups >= 1, (
+                "restarted replica should have needed snapshot catch-up")
+            assert followers[1].applied_seq == primary.applied_seq, (
+                f"catch-up left follower-2 at seq "
+                f"{followers[1].applied_seq}, head {primary.applied_seq}")
+            print(f"# fleet selftest: follower-2 restarted, replayed its "
+                  f"WAL to seq {seq_at_kill}, snapshot catch-up to seq "
+                  f"{head} ({applied} records this pump)")
+            # the two rebuilds above legitimately traced their cold-start
+            # buckets; re-warm the new engine and re-baseline — from here
+            # to the end of the drill, zero new traces is the contract
+            followers[1].engine.warmup()
+            guards["post-restart"] = primary.engine._guard.snapshot()
+        if rng.random() < 0.05:
+            one_delta()
+        else:
+            k = int(rng.integers(1, 9))
+            ids = rng.integers(0, n, k).astype(np.int32)
+            try:
+                got = router.query(ids, timeout=120.0)
+                assert got.shape[0] == k
+                answered += 1
+            except Overloaded:
+                fleet_shed += 1   # typed, counted — never silent
+
+    router.pump()
+    head = primary.applied_seq
+    for rep in router.replicas:
+        assert rep.applied_seq == head, (
+            f"{rep.name} at seq {rep.applied_seq}, head {head}: fleet "
+            f"out of lockstep after the stream")
+    print(f"# fleet selftest: 1000-event stream — {answered} answered, "
+          f"{deltas + 3} delta batches to seq {head}, "
+          f"{fleet_shed} shed at the router")
+
+    # -- parity: every replica bitwise vs the single-engine oracle ----------
+    want = oracle.query(all_ids, timeout=120.0)
+    routed = router.query(all_ids, timeout=120.0)
+    assert max_ulp_diff(routed, want) == 0, "routed query diverged"
+    for rep in router.replicas:
+        got = rep.engine.query(all_ids, timeout=120.0)
+        ulps = max_ulp_diff(got, want)
+        assert ulps == 0, (
+            f"{rep.name} diverged from the single-engine oracle by "
+            f"{ulps} ULPs (want bitwise)")
+    print(f"# fleet selftest: parity — all 3 replicas bitwise-identical "
+          f"to the single-engine oracle (0 ULPs), incl. the restarted one")
+
+    # -- zero retraces / zero plan rebuilds across the whole drill ----------
+    # (trace notes are global: the post-restart baseline covers every
+    # live engine — 300 more events, catch-up replay, parity queries)
+    primary.engine._guard.assert_no_new_traces(guards["post-restart"])
+    assert _B.plan_build_count() == builds0, (
+        "the drill rebuilt a plan; replication must ride the patch path")
+    st = primary.engine.delta_stats()
+    assert st["replans"] == 0, "churn escalated to a replan"
+    print(f"# fleet selftest: zero retraces outside the sanctioned "
+          f"restart window, zero plan rebuilds fleet-wide, zero replans")
+
+    # -- typed backpressure: deadline-expired requests are counted ----------
+    futs = [router.submit([int(i % n)], deadline_s=0.0) for i in range(16)]
+    expired = 0
+    for f in futs:
+        try:
+            f.result(timeout=30.0)
+        except Overloaded:
+            expired += 1
+    rstats = router.stats()
+    assert expired > 0 and rstats["expired"] >= expired
+    assert wd.fleet_observed > 0, "observe_fleet never fed"
+    print(f"# fleet selftest: backpressure typed + counted "
+          f"(expired={rstats['expired']}, shed={rstats['shed']}, "
+          f"sibling_retries={rstats['sibling_retries']}); replication "
+          f"lag EWMA fed {wd.fleet_observed} times, "
+          f"{rstats['replog']['segments_shipped']} segments shipped")
+
+    oracle.close()
+    router.close()
+    print("# fleet selftest: OK")
+    return 0
+
+
+def main(argv) -> int:
+    if "--selftest" in argv:
+        return selftest()
+    print("usage: python -m roc_tpu.fleet --selftest", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

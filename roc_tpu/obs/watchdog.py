@@ -67,6 +67,9 @@ class PerfWatchdog:
         # delta-apply latency EWMA (dynamic-graph serving runs only)
         self.delta_ewma: Optional[float] = None
         self.delta_observed = 0
+        # fleet replication-lag EWMA (roc_tpu/fleet router runs only)
+        self.fleet_ewma: Optional[float] = None
+        self.fleet_observed = 0
         # per-cost-model measured/predicted ratio EWMAs (ledger feed)
         self.calibration_band = (float(calibration_band[0]),
                                  float(calibration_band[1]))
@@ -79,6 +82,7 @@ class PerfWatchdog:
     _STATE_KEYS = ("ewma", "observed", "seeded", "stall_ewma",
                    "stall_observed", "serve_ewma", "serve_observed",
                    "delta_ewma", "delta_observed",
+                   "fleet_ewma", "fleet_observed",
                    "calib_ewma", "calib_observed", "nonfinite_steps")
 
     def state_dict(self) -> dict:
@@ -195,6 +199,34 @@ class PerfWatchdog:
         self.delta_observed += 1
         return alert
 
+    def observe_fleet(self, event: int, lag_s: float,
+                      shed_rate: float = 0.0) -> Optional[dict]:
+        """Feed one fleet replication-lag sample (roc_tpu/fleet/router.py
+        feeds the seal-to-applied wall per shipped segment, worst
+        follower).  Alert when the lag exceeds ``ratio`` x its own EWMA
+        — a follower falling behind shows up here before the freshness
+        floor starts starving the dispatcher.  The alert carries the
+        router's current shed rate so autoscale decisions in the JSONL
+        are reconstructable.  Observation 0 carries first-segment
+        device_put/trace noise and never sets the baseline, mirroring
+        observe_serve."""
+        lag = float(lag_s)
+        armed = self.fleet_ewma is not None and \
+            self.fleet_observed >= self.warmup
+        alert = None
+        if armed and lag > self.ratio * self.fleet_ewma:
+            alert = {"kind": "fleet-lag", "event": int(event),
+                     "lag_s": lag, "ewma_s": float(self.fleet_ewma),
+                     "ratio": lag / self.fleet_ewma,
+                     "shed_rate": float(shed_rate)}
+            self.alerts.append(alert)
+            lag = self.ratio * self.fleet_ewma  # clamp, as observe_epoch
+        if self.fleet_observed >= 1:
+            self.fleet_ewma = lag if self.fleet_ewma is None else \
+                self.alpha * lag + (1.0 - self.alpha) * self.fleet_ewma
+        self.fleet_observed += 1
+        return alert
+
     def observe_nonfinite(self, epoch: int,
                           consecutive: int) -> Optional[dict]:
         """Feed one skipped (non-finite loss/grad) step from the in-graph
@@ -257,8 +289,8 @@ class PerfWatchdog:
         """"nonfinite" outranks everything (numerics beat perf), then
         "regressed" if any slow-epoch fired, then "straggler", then
         "stream-stall", then "serve-latency", then "delta-apply", then
-        "calibration-drift", "ok" otherwise — stamped into bench
-        artifacts."""
+        "fleet-lag", then "calibration-drift", "ok" otherwise — stamped
+        into bench artifacts."""
         kinds = {a["kind"] for a in self.alerts}
         if "nonfinite" in kinds:
             return "nonfinite"
@@ -272,6 +304,8 @@ class PerfWatchdog:
             return "serve-latency"
         if "delta-apply" in kinds:
             return "delta-apply"
+        if "fleet-lag" in kinds:
+            return "fleet-lag"
         if "calibration-drift" in kinds:
             return "calibration-drift"
         return "ok"

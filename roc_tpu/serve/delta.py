@@ -223,6 +223,14 @@ class DeltaJournal:
         self._f = open(self.path, "r+b")
         self._size = os.path.getsize(self.path)
 
+    def records_after(self, seq: int):
+        """Resident records with sequence number > ``seq``, in order —
+        the replication-log read API (roc_tpu/fleet/replog.py seals
+        these into shipped segments).  Records folded into a snapshot by
+        ``truncate_to`` are gone from here by design: a follower that
+        needs them catches up from the snapshot instead."""
+        return [(s, a, r) for s, a, r in self.records if s > seq]
+
     def close(self) -> None:
         self._f.close()
 
@@ -837,6 +845,20 @@ class DeltaManager:
             if alert is not None and self.verbose:
                 print(f"# watchdog: delta apply {alert['apply_s']*1e3:.2f} "
                       f"ms is {alert['ratio']:.2f}x its EWMA")
+
+    @property
+    def applied_seq(self) -> int:
+        """Watermark: the highest delta sequence number whose effects are
+        visible to queries (the fleet router reads this for its freshness
+        floor; roc_tpu/fleet/replica.py exports it per replica)."""
+        return self._seq
+
+    @property
+    def snapshot_path(self) -> Optional[str]:
+        """Where `checkpoint()` writes the live-edge snapshot (None when
+        running volatile).  The fleet snapshot protocol ships this file
+        plus the truncated journal to a catching-up replica."""
+        return self._snap_path
 
     def stats(self) -> dict:
         out = dict(self.counters)

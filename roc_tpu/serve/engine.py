@@ -315,6 +315,16 @@ class ServeEngine:
     def delta_stats(self) -> dict:
         return self.deltas.stats() if self.deltas is not None else {}
 
+    def delta_seq(self) -> int:
+        """Applied-delta watermark (0 without delta support) — the
+        per-replica freshness signal the fleet router dispatches on."""
+        return self.deltas.applied_seq if self.deltas is not None else 0
+
+    def pending(self) -> int:
+        """Requests queued but not yet drained — the engine's share of
+        the router's least-loaded dispatch signal."""
+        return self.queue.depth() if self.queue is not None else 0
+
     def checkpoint_deltas(self) -> None:
         """Fold the delta journal into a verified snapshot + truncate
         (one crash-consistent unit; see DeltaManager.checkpoint)."""

@@ -143,6 +143,13 @@ class MicrobatchQueue:
         """Blocking submit: the request's [k, C] logits."""
         return self.submit(node_ids).result(timeout)
 
+    def depth(self) -> int:
+        """Pending (undrained) request count — the queue's load signal
+        (fleet router least-loaded dispatch; len() under the CV so a
+        concurrent drain never yields a torn read)."""
+        with self._cv:
+            return len(self._pending)
+
     def close(self):
         """Graceful drain: the worker finishes whatever is already
         queued (``_drain`` keeps handing out windows after close until

@@ -1,0 +1,680 @@
+"""Replicated serving fleet (roc_tpu/fleet/).
+
+The contract under test mirrors ISSUE 17's acceptance gates at the
+layer where they are cheap to pin (the full ServeEngine fleet drill is
+``python -m roc_tpu.fleet --selftest``, wired into preflight):
+
+- segment codec: byte-exact roundtrip and the torn / bit-rot / gap
+  taxonomy, all-or-nothing decode (same classification rules as the PR
+  15 journal open);
+- transports: in-proc ordering + bounded backlog, spool-directory
+  restart resume (writer cursor survives, reader re-reads are deduped
+  by the watermark), localhost TCP framing;
+- replication parity: a primary DeltaManager shipping WAL segments to
+  two follower managers stays in bitwise seq-lockstep — identical plan
+  bytes and bitwise-identical aggregation after a mixed add/retire
+  stream, with a late follower caught up through the snapshot protocol
+  (checkpoint-then-truncate worn sideways);
+- kill-window chaos matrix: a seeded kill on either side of the
+  publish, mid-replay on a follower, or mid snapshot-install never
+  loses an acked delta and never applies one twice — re-ship is
+  filtered by the watermark, restart replays the follower's own WAL,
+  re-install is idempotent; the transient ``fleet.ship`` site is
+  absorbed by the retry budget and becomes a typed failure beyond it;
+- router semantics: least-loaded dispatch under a freshness floor,
+  sibling retry on Overloaded, typed FleetOverloaded when the fleet
+  sheds (never silent), the autoscale ladder's spawn/drain/cooldown;
+- observability: observe_fleet EWMA warmup/alert/clamp, verdict
+  ranking, checkpoint state roundtrip.
+"""
+
+import struct
+import threading
+import warnings
+import zlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from roc_tpu.fault import inject, retry
+from roc_tpu.fleet.replog import (FileTransport, InProcTransport,
+                                  ReplicationError, ReplicationLog,
+                                  SegmentGapError, SegmentRotError,
+                                  SocketTransport, TornSegmentError,
+                                  decode_segment, encode_segment,
+                                  install_snapshot_files, replay_segment)
+from roc_tpu.fleet.router import FleetOverloaded, FleetRouter
+from roc_tpu.graph.csr import from_edges
+from roc_tpu.obs.watchdog import PerfWatchdog
+from roc_tpu.ops.aggregate import BinnedPlans
+from roc_tpu.ops.pallas import binned
+from roc_tpu.serve.delta import _LEN, _REC, DeltaManager
+from roc_tpu.serve.queue import Overloaded
+from roc_tpu.train.driver import DenseGraphData
+
+
+# -- fixtures (same graph discipline as tests/test_delta.py) ----------------
+
+N_NODES = 96
+N_EDGES = 200     # base edges on nodes 0..63; >= 64 is fresh territory
+
+
+def _graph(seed=3, n=N_NODES, e=N_EDGES):
+    rng = np.random.default_rng(seed)
+    return from_edges(n, rng.integers(0, 64, e), rng.integers(0, 64, e))
+
+
+def _gdata(csr):
+    s = np.asarray(csr.col_idx, np.int64)
+    d = np.asarray(csr.dst_idx, np.int64)
+    n = csr.num_nodes
+    fwd = binned.build_binned_plan(s, d, n, n, tuned_ok=False)
+    bwd = binned.build_binned_plan(d, s, n, n, tuned_ok=False)
+    return DenseGraphData(
+        edge_src=jnp.asarray(s, jnp.int32),
+        edge_dst=jnp.asarray(d, jnp.int32),
+        in_degree=jnp.asarray(np.bincount(d, minlength=n), jnp.float32),
+        plans=BinnedPlans(fwd=fwd, bwd=bwd),
+        backend="binned", precision="exact")
+
+
+def _manager(csr, journal_path, **kw):
+    holder = {"gd": _gdata(csr)}
+    mgr = DeltaManager(lambda: holder["gd"],
+                       lambda g: holder.__setitem__("gd", g),
+                       threading.RLock(), csr.num_nodes,
+                       journal_path=journal_path, **kw)
+    return holder, mgr
+
+
+def _plan_bytes(holder):
+    gd = holder["gd"]
+    return b"".join(np.asarray(a).tobytes() for a in (
+        gd.plans.fwd.p1_srcl, gd.plans.fwd.p2_dstl,
+        gd.plans.bwd.p1_srcl, gd.plans.bwd.p2_dstl))
+
+
+def _agg(holder, x):
+    return np.asarray(binned.run_binned(x, holder["gd"].plans.fwd,
+                                        interpret=True))
+
+
+def _quiet_apply(mgr, *a, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        return mgr.apply(*a, **kw)
+
+
+class _StubEngine:
+    """The two attributes ReplicationLog reads off a ServeEngine."""
+
+    def __init__(self, mgr):
+        self.deltas = mgr
+
+    def delta_seq(self):
+        return self.deltas.applied_seq
+
+
+def _primary(csr, tmp_path, name="primary"):
+    holder, mgr = _manager(csr, str(tmp_path / f"{name}.wal"))
+    return holder, mgr, ReplicationLog(_StubEngine(mgr))
+
+
+def _replay_into(fmgr, seg):
+    """Follower half at manager level: exactly-once replay of one
+    segment through fmgr.apply, seq lockstep pinned per record."""
+    def _apply(seq, add, ret):
+        res = _quiet_apply(fmgr, add if len(add) else None,
+                           ret if len(ret) else None)
+        assert res["seq"] == seq, (res["seq"], seq)
+    return replay_segment(seg, fmgr.applied_seq, _apply)
+
+
+def _records(seqs):
+    return [(s, np.asarray([[64 + s, 65 + s]], np.int64),
+             np.zeros((0, 2), np.int64)) for s in seqs]
+
+
+# -- segment codec ----------------------------------------------------------
+
+def test_segment_roundtrip():
+    recs = [(5, np.asarray([[70, 71], [72, 73]], np.int64),
+             np.asarray([[10, 11]], np.int64)),
+            (6, np.zeros((0, 2), np.int64),
+             np.asarray([[70, 71]], np.int64)),
+            (7, np.asarray([[80, 81]], np.int64),
+             np.zeros((0, 2), np.int64))]
+    seg = encode_segment(recs, sealed_at=123.25)
+    out, sealed_at = decode_segment(seg)
+    assert sealed_at == 123.25
+    assert [r[0] for r in out] == [5, 6, 7]
+    for (_, a0, r0), (_, a1, r1) in zip(recs, out):
+        np.testing.assert_array_equal(a0, a1)
+        np.testing.assert_array_equal(r0, r1)
+
+
+def test_segment_encode_rejects_sparse_seqs():
+    with pytest.raises(AssertionError):
+        encode_segment(_records([1, 3]))
+
+
+def test_segment_taxonomy_torn():
+    seg = encode_segment(_records([1, 2, 3]))
+    # torn inside the header
+    with pytest.raises(TornSegmentError):
+        decode_segment(seg[:10])
+    # torn inside the body (crash window a retried transport re-ships)
+    with pytest.raises(TornSegmentError):
+        decode_segment(seg[:-5])
+
+
+def test_segment_taxonomy_bit_rot():
+    seg = encode_segment(_records([1, 2]))
+    with pytest.raises(SegmentRotError):
+        decode_segment(b"XXX" + seg[3:])            # bad magic
+    hdr_flip = bytearray(seg)
+    hdr_flip[6] ^= 0x40                             # header payload bit
+    with pytest.raises(SegmentRotError):
+        decode_segment(bytes(hdr_flip))
+    body_flip = bytearray(seg)
+    body_flip[-6] ^= 0x01                           # record payload bit
+    with pytest.raises(SegmentRotError):
+        decode_segment(bytes(body_flip))
+    with pytest.raises(SegmentRotError):
+        decode_segment(seg + b"\x00")               # trailing bytes
+
+
+def test_segment_taxonomy_in_segment_gap():
+    # hand-framed: header promises [1, 2] but the records are 1 then 3
+    body = bytearray()
+    for seq in (1, 3):
+        rec = _REC.pack(seq, 0, 0)
+        body += _LEN.pack(len(rec)) + rec \
+            + _LEN.pack(zlib.crc32(rec) & 0xFFFFFFFF)
+    hdr = b"RSG1" + struct.pack("<QQId", 1, 2, 2, 0.0)
+    hdr += _LEN.pack(zlib.crc32(hdr) & 0xFFFFFFFF)
+    with pytest.raises(SegmentGapError):
+        decode_segment(bytes(hdr + body))
+
+
+def test_replay_segment_dedup_and_gap():
+    seg = encode_segment(_records([1, 2, 3]))
+    seen = []
+    applied, skipped, _ = replay_segment(
+        seg, 0, lambda s, a, r: seen.append(s))
+    assert (applied, skipped, seen) == (3, 0, [1, 2, 3])
+    # at-least-once re-delivery: watermark filters every record
+    applied, skipped, _ = replay_segment(
+        seg, 3, lambda s, a, r: seen.append(s))
+    assert (applied, skipped, seen) == (0, 3, [1, 2, 3])
+    # partial overlap replays only the tail
+    applied, skipped, _ = replay_segment(
+        seg, 1, lambda s, a, r: seen.append(s))
+    assert (applied, skipped, seen[3:]) == (2, 1, [2, 3])
+    # a segment starting past watermark + 1 is a gap, not a replay
+    n = len(seen)
+    with pytest.raises(SegmentGapError):
+        replay_segment(encode_segment(_records([5, 6])), 3,
+                       lambda s, a, r: seen.append(s))
+    assert len(seen) == n   # gap applied nothing
+
+
+# -- transports -------------------------------------------------------------
+
+def test_inproc_transport_order_and_backlog():
+    tr = InProcTransport(maxlen=2)
+    assert tr.recv(0.0) is None and tr.depth() == 0
+    tr.send(b"a")
+    tr.send(b"b")
+    with pytest.raises(ReplicationError):
+        tr.send(b"c")          # follower not draining: bounded, typed
+    assert tr.depth() == 2
+    assert tr.recv(0.0) == b"a" and tr.recv(0.0) == b"b"
+    assert tr.recv(0.0) is None
+
+
+def test_file_transport_restart_resume(tmp_path):
+    spool = str(tmp_path / "spool")
+    w = FileTransport(spool)
+    w.send(b"seg-one")
+    w.send(b"seg-two")
+    # writer restart must resume the cursor, not overwrite spooled work
+    w2 = FileTransport(spool)
+    w2.send(b"seg-three")
+    r = FileTransport(spool)
+    got = [r.recv(0.0) for _ in range(3)]
+    assert got == [b"seg-one", b"seg-two", b"seg-three"]
+    assert r.recv(0.0) is None
+    # reader restart re-reads from the top: at-least-once delivery the
+    # follower watermark dedups (replay_segment skips <= applied_seq)
+    r2 = FileTransport(spool)
+    assert r2.recv(0.0) == b"seg-one"
+
+
+def test_socket_transport_roundtrip():
+    follower = SocketTransport.listen()
+    primary = SocketTransport.connect(follower.port)
+    try:
+        seg = encode_segment(_records([1, 2]))
+        primary.send(seg)
+        primary.send(b"tiny")
+        assert follower.recv(5.0) == seg
+        assert follower.recv(5.0) == b"tiny"
+        assert follower.recv(0.05) is None    # drained: timeout, not hang
+    finally:
+        primary.close()
+        follower.close()
+
+
+# -- manager-level replication parity ---------------------------------------
+
+def test_fleet_lockstep_parity_mixed_stream(tmp_path):
+    """Primary + two followers replaying shipped WAL segments end with
+    identical plan bytes and bitwise-identical aggregation."""
+    csr = _graph()
+    holder, mgr, replog = _primary(csr, tmp_path)
+    fh1, fm1 = _manager(csr, str(tmp_path / "f1.wal"))
+    fh2, fm2 = _manager(csr, str(tmp_path / "f2.wal"))
+    fresh = iter([(a, b) for a in range(64, 96) for b in range(64, 96)])
+    tracked = []
+    rng = np.random.default_rng(17)
+    for batch in range(30):
+        add = [next(fresh) for _ in range(2)]
+        tracked.extend(add)
+        ret = None
+        if len(tracked) >= 16:   # keep net growth inside cell headroom
+            k = int(rng.integers(1, 3))
+            ret, tracked = np.asarray(tracked[:k]), tracked[k:]
+        _quiet_apply(mgr, np.asarray(add), ret)
+        if batch % 3 == 2:       # several records per sealed segment
+            seg = replog.ship()
+            assert seg is not None
+            for fm in (fm1, fm2):
+                _replay_into(fm, seg)
+    seg = replog.ship()
+    if seg is not None:
+        for fm in (fm1, fm2):
+            _replay_into(fm, seg)
+    assert replog.ship() is None             # idempotent at the watermark
+    assert fm1.applied_seq == fm2.applied_seq == mgr.applied_seq == 30
+    assert _plan_bytes(fh1) == _plan_bytes(holder)
+    assert _plan_bytes(fh2) == _plan_bytes(holder)
+    x = jnp.asarray(np.eye(N_NODES, 8, dtype=np.float32))
+    ref = _agg(holder, x)
+    np.testing.assert_array_equal(_agg(fh1, x), ref)
+    np.testing.assert_array_equal(_agg(fh2, x), ref)
+    assert replog.stats()["records_shipped"] == 30
+    for m in (mgr, fm1, fm2):
+        m.close()
+
+
+def test_late_follower_snapshot_catch_up(tmp_path):
+    """A follower joining after a checkpoint truncated the primary's
+    journal sees a typed gap, installs the snapshot pair, and converges
+    bitwise — the checkpoint-then-truncate cycle IS the catch-up
+    protocol."""
+    csr = _graph()
+    holder, mgr, replog = _primary(csr, tmp_path)
+    for k in range(6):
+        _quiet_apply(mgr, np.asarray([[64 + k, 80 + k]]), None)
+    replog.ship()                 # shipped, but follower B never saw it
+    mgr.checkpoint()              # journal truncated: records 1..6 gone
+    for k in range(3):
+        _quiet_apply(mgr, np.asarray([[70 + k, 90 + k]]), None)
+    seg = replog.ship()           # seals 7..9 only
+    fp = str(tmp_path / "late.wal")
+    fh, fm = _manager(csr, fp)
+    with pytest.raises(SegmentGapError):
+        _replay_into(fm, seg)
+    assert fm.applied_seq == 0    # the gap applied nothing
+    fm.close()
+    snap, jour, seq = replog.snapshot_blob()
+    assert seq == mgr.applied_seq == 9
+    install_snapshot_files(snap, jour, fp + ".snapshot.npz", fp)
+    fh, fm = _manager(csr, fp)    # restart over the installed pair
+    assert fm.applied_seq == 9
+    # stream continues: the caught-up follower replays like any other
+    _quiet_apply(mgr, np.asarray([[66, 94]]), None)
+    _replay_into(fm, replog.ship())
+    assert fm.applied_seq == mgr.applied_seq == 10
+    assert _plan_bytes(fh) == _plan_bytes(holder)
+    x = jnp.asarray(np.eye(N_NODES, 8, dtype=np.float32))
+    np.testing.assert_array_equal(_agg(fh, x), _agg(holder, x))
+    for m in (mgr, fm):
+        m.close()
+
+
+def test_replication_log_requires_journal(tmp_path):
+    csr = _graph()
+    holder, mgr = _manager(csr, "")      # volatile: no WAL, no fleet
+    with pytest.raises(ReplicationError):
+        ReplicationLog(_StubEngine(mgr))
+    mgr.close()
+
+
+# -- kill-window chaos matrix ------------------------------------------------
+
+def test_ship_kill_pre_nothing_published(tmp_path):
+    csr = _graph()
+    holder, mgr, replog = _primary(csr, tmp_path)
+    tr = replog.attach(InProcTransport())
+    _quiet_apply(mgr, np.asarray([[64, 80]]), None)
+    inject.configure("seed=2,fleet.ship.kill_pre=1")
+    try:
+        with pytest.raises(inject.SimulatedCrash):
+            replog.ship()
+    finally:
+        inject.configure("")
+    assert tr.depth() == 0 and replog.shipped_seq == 0   # nothing out
+    seg = replog.ship()                                  # re-ship heals
+    assert tr.depth() == 1 and replog.shipped_seq == 1
+    fh, fm = _manager(csr, str(tmp_path / "f.wal"))
+    _replay_into(fm, seg)
+    assert fm.applied_seq == 1
+    assert _plan_bytes(fh) == _plan_bytes(holder)
+    for m in (mgr, fm):
+        m.close()
+
+
+def test_ship_kill_post_duplicate_deduped(tmp_path):
+    """Kill AFTER the publish but before the watermark advance: the
+    re-ship delivers the same records twice; the follower's watermark
+    makes the second delivery a no-op (exactly-once apply)."""
+    csr = _graph()
+    holder, mgr, replog = _primary(csr, tmp_path)
+    tr = replog.attach(InProcTransport())
+    _quiet_apply(mgr, np.asarray([[64, 80]]), None)
+    _quiet_apply(mgr, np.asarray([[65, 81]]), None)
+    inject.configure("seed=2,fleet.ship.kill_post=1")
+    try:
+        with pytest.raises(inject.SimulatedCrash):
+            replog.ship()
+    finally:
+        inject.configure("")
+    assert tr.depth() == 1 and replog.shipped_seq == 0   # out, unacked
+    replog.ship()
+    assert tr.depth() == 2 and replog.shipped_seq == 2   # duplicate
+    fh, fm = _manager(csr, str(tmp_path / "f.wal"))
+    applied = skipped = 0
+    while (seg := tr.recv(0.0)) is not None:
+        a, s, _ = _replay_into(fm, seg)
+        applied += a
+        skipped += s
+    assert (applied, skipped) == (2, 2)
+    assert fm.applied_seq == 2
+    assert _plan_bytes(fh) == _plan_bytes(holder)
+    for m in (mgr, fm):
+        m.close()
+
+
+def test_ship_transient_fault_retried_then_typed(tmp_path):
+    csr = _graph()
+    holder, mgr, replog = _primary(csr, tmp_path)
+    tr = replog.attach(InProcTransport())
+    _quiet_apply(mgr, np.asarray([[64, 80]]), None)
+    retry.reset_retry_counts()
+    # two transient faults: absorbed inside the 3-attempt budget
+    inject.configure("seed=2,fleet.ship=2")
+    try:
+        assert replog.ship() is not None
+    finally:
+        inject.configure("")
+    assert tr.depth() == 1 and replog.shipped_seq == 1
+    assert retry.retry_counts().get("fleet.ship", 0) == 2
+    # beyond the budget: a typed failure, watermark not advanced
+    _quiet_apply(mgr, np.asarray([[65, 81]]), None)
+    inject.configure("seed=2,fleet.ship=3")
+    try:
+        with pytest.raises(inject.InjectedFault):
+            replog.ship()
+    finally:
+        inject.configure("")
+    assert replog.shipped_seq == 1
+    assert replog.ship() is not None and replog.shipped_seq == 2
+    mgr.close()
+
+
+def test_replay_kill_mid_segment_restart_converges(tmp_path):
+    """Follower dies between records of one segment: its own WAL holds
+    the applied prefix, restart restores it, and the re-delivered
+    segment's already-applied records dedup through the watermark."""
+    csr = _graph()
+    holder, mgr, replog = _primary(csr, tmp_path)
+    for k in range(3):
+        _quiet_apply(mgr, np.asarray([[64 + k, 80 + k]]), None)
+    seg = replog.ship()          # one segment, three records
+    fp = str(tmp_path / "f.wal")
+    fh, fm = _manager(csr, fp)
+    inject.configure("seed=2,fleet.replay.kill_mid=1")
+    try:
+        with pytest.raises(inject.SimulatedCrash):
+            _replay_into(fm, seg)
+    finally:
+        inject.configure("")
+    assert fm.applied_seq == 1   # exactly the journaled prefix
+    fm.close()
+    fh, fm = _manager(csr, fp)   # follower restart: WAL replays record 1
+    assert fm.applied_seq == 1
+    applied, skipped, _ = _replay_into(fm, seg)   # transport re-delivery
+    assert (applied, skipped) == (2, 1)
+    assert fm.applied_seq == mgr.applied_seq == 3
+    assert _plan_bytes(fh) == _plan_bytes(holder)
+    for m in (mgr, fm):
+        m.close()
+
+
+def test_snapshot_install_kill_window_idempotent(tmp_path):
+    csr = _graph()
+    holder, mgr, replog = _primary(csr, tmp_path)
+    for k in range(4):
+        _quiet_apply(mgr, np.asarray([[64 + k, 80 + k]]), None)
+    snap, jour, seq = replog.snapshot_blob()
+    fp = str(tmp_path / "f.wal")
+    inject.configure("seed=2,fleet.snap.kill_install=1")
+    try:
+        with pytest.raises(inject.SimulatedCrash):
+            install_snapshot_files(snap, jour, fp + ".snapshot.npz", fp)
+    finally:
+        inject.configure("")
+    import os
+    assert os.path.exists(fp + ".snapshot.npz")   # first half landed
+    assert not os.path.exists(fp)                 # second half did not
+    # recovery is re-running the install from the top
+    install_snapshot_files(snap, jour, fp + ".snapshot.npz", fp)
+    fh, fm = _manager(csr, fp)
+    assert fm.applied_seq == seq == 4
+    assert _plan_bytes(fh) == _plan_bytes(holder)
+    for m in (mgr, fm):
+        m.close()
+
+
+# -- router semantics (stub replicas: no jax, pure dispatch logic) -----------
+
+class _StubReplica:
+    def __init__(self, name, seq=0, load=0, overloaded=False):
+        self.name = name
+        self.alive = True
+        self.applied_seq = seq
+        self.load = load
+        self.overloaded = overloaded
+        self.submitted = []
+        self.transport = None
+        self.last_lag_s = 0.0
+
+    def submit(self, node_ids, deadline_s=None):
+        if self.overloaded:
+            raise Overloaded(f"{self.name} at depth cap")
+        self.submitted.append(node_ids)
+        return (self.name, node_ids)
+
+    def close(self):
+        self.alive = False
+
+
+class _StubLog:
+    shipped_seq = 0
+
+    def ship(self):
+        return None
+
+    def detach(self, transport):
+        pass
+
+    def stats(self):
+        return {"shipped_seq": 0, "segments_shipped": 0,
+                "records_shipped": 0, "transports": 0}
+
+
+def _stub_router(primary, followers, **kw):
+    return FleetRouter(primary, followers, _StubLog(), **kw)
+
+
+def test_router_least_loaded_dispatch():
+    p = _StubReplica("p", seq=5, load=7)
+    f1 = _StubReplica("f1", seq=5, load=3)
+    f2 = _StubReplica("f2", seq=5, load=1)
+    r = _stub_router(p, [f1, f2])
+    fut = r.submit([0, 1])
+    assert fut[0] == "f2" and f2.submitted == [[0, 1]]
+    assert r.routed == 1 and r.shed == 0
+
+
+def test_router_freshness_floor():
+    p = _StubReplica("p", seq=10, load=9)
+    stale = _StubReplica("stale", seq=7, load=0)
+    r = _stub_router(p, [stale], freshness_floor=0)
+    assert r.eligible() == [p]            # read-your-writes excludes it
+    assert r.submit([1])[0] == "p"
+    r.freshness_floor = 3
+    assert r.eligible() == [p, stale]     # floor 3: 10 - 7 just makes it
+    r.freshness_floor = None
+    assert r.eligible() == [p, stale]     # eventual consistency: all in
+    stale.alive = False
+    assert r.eligible() == [p]            # dead is never eligible
+
+
+def test_router_sibling_retry_then_typed_shed():
+    p = _StubReplica("p", load=5, overloaded=True)
+    f1 = _StubReplica("f1", load=1, overloaded=True)
+    f2 = _StubReplica("f2", load=2)
+    r = _stub_router(p, [f1, f2], max_retries=2)
+    fut = r.submit([3])                   # f1 sheds, f2 absorbs the retry
+    assert fut[0] == "f2"
+    assert r.sibling_retries == 1 and r.shed == 0
+    f2.overloaded = True                  # now the whole fleet sheds
+    with pytest.raises(FleetOverloaded):
+        r.submit([4])
+    assert r.shed == 1
+    # FleetOverloaded IS an Overloaded: single-engine backoff still works
+    assert issubclass(FleetOverloaded, Overloaded)
+
+
+def test_router_retry_budget_respected():
+    reps = [_StubReplica(f"r{i}", load=i, overloaded=True)
+            for i in range(4)]
+    ok = _StubReplica("ok", load=9)       # ranked last (deepest queue)
+    r = _stub_router(reps[0], reps[1:] + [ok], max_retries=1)
+    with pytest.raises(FleetOverloaded):
+        r.submit([1])                     # budget spent before reaching ok
+    assert r.sibling_retries == 2         # first try + one sibling retry
+    assert ok.submitted == []
+
+
+def test_router_no_eligible_is_typed_shed():
+    p = _StubReplica("p", seq=10)
+    p.alive = False
+    r = _stub_router(p, [], freshness_floor=0)
+    with pytest.raises(FleetOverloaded):
+        r.submit([1])
+    assert r.shed == 1
+
+
+def test_router_autoscale_spawn_on_shed():
+    p = _StubReplica("p", overloaded=True)
+    spawned = []
+
+    def spawn():
+        rep = _StubReplica(f"auto-{len(spawned)}")
+        spawned.append(rep)
+        return rep
+
+    r = _stub_router(p, [], spawn_cb=spawn, drain_cb=lambda rep: None,
+                     up_shed_rate=0.05, scale_cooldown=2)
+    with pytest.raises(FleetOverloaded):
+        r.submit([1])                     # 100% shed this window
+    event = r.maybe_scale()
+    assert event is not None and event["action"] == "spawn"
+    assert event["reason"] == "shed-rate"
+    assert r.followers == spawned and len(spawned) == 1
+    # cooldown: an immediately hot next window may NOT spawn again
+    p.overloaded = False
+    spawned[0].overloaded = True
+    r._win_shed, r._win_submits = 5, 5
+    assert r.maybe_scale() is None
+    assert len(spawned) == 1
+
+
+def test_router_autoscale_drain_on_quiet():
+    p = _StubReplica("p", load=0)
+    f = _StubReplica("f", load=0)
+    drained = []
+    r = _stub_router(p, [f], spawn_cb=None, drain_cb=drained.append,
+                     scale_cooldown=2, min_replicas=1)
+    for _ in range(4):                    # quiet windows accumulate
+        r.maybe_scale()
+    assert [e["action"] for e in r.scale_events] == ["drain"]
+    assert drained == [f] and r.followers == []
+    # at min_replicas the ladder stops draining
+    for _ in range(8):
+        assert r.maybe_scale() is None
+    assert r.replicas == [p]
+
+
+def test_router_autoscale_spawn_on_watchdog_alert():
+    wd = PerfWatchdog(warmup=1)
+    p = _StubReplica("p")
+    spawned = []
+    r = _stub_router(p, [], watchdog=wd,
+                     spawn_cb=lambda: spawned.append(
+                         _StubReplica("auto")) or spawned[-1],
+                     scale_cooldown=1)
+    wd.alerts.append({"kind": "fleet-lag", "event": 0, "lag_s": 1.0,
+                      "ewma_s": 0.1, "ratio": 10.0, "shed_rate": 0.0})
+    event = r.maybe_scale()
+    assert event is not None and event["reason"] == "watchdog"
+    assert len(spawned) == 1
+
+
+# -- observe_fleet + verdict -------------------------------------------------
+
+def test_watchdog_observe_fleet_warmup_alert_clamp():
+    wd = PerfWatchdog()                   # ratio 2.0, warmup 2
+    assert wd.observe_fleet(0, 0.01) is None   # obs 0: never a baseline
+    assert wd.fleet_ewma is None
+    assert wd.observe_fleet(1, 0.01) is None   # sets the baseline
+    assert wd.fleet_ewma == pytest.approx(0.01)
+    assert wd.observe_fleet(2, 0.01) is None
+    alert = wd.observe_fleet(3, 0.1, shed_rate=0.25)
+    assert alert is not None and alert["kind"] == "fleet-lag"
+    assert alert["ratio"] == pytest.approx(10.0)
+    assert alert["shed_rate"] == 0.25          # autoscale context carried
+    # the EWMA absorbed the CLAMPED sample, not the 10x outlier
+    assert wd.fleet_ewma < 0.02
+    assert wd.verdict() == "fleet-lag"
+    # numerics outrank replication lag in the verdict
+    wd.observe_nonfinite(0, 1)
+    assert wd.verdict() == "nonfinite"
+
+
+def test_watchdog_fleet_state_roundtrip():
+    wd = PerfWatchdog()
+    for i in range(4):
+        wd.observe_fleet(i, 0.02)
+    wd2 = PerfWatchdog()
+    wd2.load_state(wd.state_dict())
+    assert wd2.fleet_ewma == wd.fleet_ewma
+    assert wd2.fleet_observed == wd.fleet_observed
+    # a restored watchdog is armed: no re-warming after resume
+    assert wd2.observe_fleet(4, 1.0) is not None

@@ -297,6 +297,19 @@ timeout 1200 env ROC_SERVE_BENCH_DATASET=reddit-small \
     ROC_SERVE_BENCH_REQUESTS=200 ROC_SERVE_BENCH_QPS=50 \
     ROC_SERVE_BENCH_DELTAS=100 \
     python tools/serve_bench.py 2>&1 | tail -1 | tee -a "$LOG"
+
+note "5e. on-device fleet drill (roc_tpu/fleet): 3 replicas behind the"
+note "    router on the real chip — WAL-shipped segment replication in"
+note "    seq lockstep (bitwise parity vs a single-engine oracle), a"
+note "    seeded replica kill + snapshot catch-up mid-stream, typed"
+note "    backpressure counted.  Then the bench's --fleet sweep records"
+note "    router p50/p99 + shed rate + replication lag p99 fault-free"
+note "    (the fleet block of BENCH_SERVE.json)."
+timeout 900 python -m roc_tpu.fleet --selftest 2>&1 | tail -4 | tee -a "$LOG"
+timeout 1800 env ROC_SERVE_BENCH_DATASET=reddit-small \
+    ROC_SERVE_BENCH_REQUESTS=200 ROC_SERVE_BENCH_QPS=50 \
+    ROC_SERVE_BENCH_DELTAS=100 \
+    python tools/serve_bench.py --fleet 3 2>&1 | tail -1 | tee -a "$LOG"
 fi
 
 if [ "$START" -le 6 ]; then

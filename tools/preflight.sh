@@ -136,6 +136,18 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python tools/serve_bench.py --selftest || {
     echo "preflight: serve bench selftest RED" >&2; exit 1; }
 
+# Fleet drill: 3 replicas behind the router under a 1000-event mixed
+# query+delta stream — WAL-shipped segment replication keeps every
+# member in seq lockstep (bitwise parity vs a single-engine oracle,
+# zero retraces / zero plan rebuilds), a seeded hard kill of one
+# follower mid-stream loses nothing (local WAL replay + snapshot
+# catch-up while the survivors keep answering), and backpressure is
+# typed + counted (roc_tpu/fleet/__main__).
+echo "== fleet drill =="
+timeout -k 10 570 env JAX_PLATFORMS=cpu \
+    python -m roc_tpu.fleet --selftest >/dev/null || {
+    echo "preflight: fleet drill RED" >&2; exit 1; }
+
 # Fault-harness gate: the chaos machinery itself must be provably live —
 # seeded spec determinism, retry recovery/exhaustion/kill-switch, the
 # fsync-rename durability helper, the jitted non-finite skip, a seeded
