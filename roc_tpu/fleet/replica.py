@@ -126,7 +126,7 @@ class Replica:
         self.records_applied += applied
         # wall clock on purpose: the seal stamp was taken on the primary,
         # possibly in another process
-        self.last_lag_s = max(time.time() - sealed_at, 0.0)  # roclint: allow(raw-timing)
+        self.last_lag_s = max(time.time() - sealed_at, 0.0)  # roclint: allow(raw-timing) — cross-process wall-clock lag vs the primary's seal stamp
         return applied
 
     def poll(self, timeout: float = 0.0) -> int:

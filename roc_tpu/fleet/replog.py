@@ -60,6 +60,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from roc_tpu import fault
+from roc_tpu.analysis import witness as _witness
 from roc_tpu.serve.delta import _LEN, _REC
 
 __all__ = ["ReplicationError", "TornSegmentError", "SegmentGapError",
@@ -108,7 +109,7 @@ def encode_segment(records: List[Tuple[int, np.ndarray, np.ndarray]],
     if sealed_at is None:
         # wall clock, not perf_counter: the seal stamp crosses process
         # boundaries on the file/socket transports
-        sealed_at = time.time()  # roclint: allow(raw-timing)
+        sealed_at = time.time()  # roclint: allow(raw-timing) — seal stamp crosses process boundaries; wall clock required
     hdr = _SEG_MAGIC + struct.pack("<QQId", first, last, len(records),
                                    float(sealed_at))
     hdr += _LEN.pack(zlib.crc32(hdr) & 0xFFFFFFFF)
@@ -240,7 +241,8 @@ class InProcTransport(Transport):
 
     def __init__(self, maxlen: int = 4096):
         self._q: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = _witness.trace("InProcTransport._cv",
+                                  threading.Condition())
         self._maxlen = int(maxlen)
 
     def send(self, seg: bytes) -> None:
@@ -253,9 +255,16 @@ class InProcTransport(Transport):
             self._cv.notify_all()
 
     def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        # Predicate loop against a deadline: a notify stolen by a sibling
+        # follower (or a spurious wakeup) must not eat the whole timeout
+        # budget in one swallow — re-wait for whatever remains.
+        deadline = (time.perf_counter() + timeout) if timeout else None
         with self._cv:
-            if not self._q and timeout:
-                self._cv.wait(timeout)
+            while not self._q and deadline is not None:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
             return self._q.popleft() if self._q else None
 
     def depth(self) -> int:
@@ -297,7 +306,7 @@ class FileTransport(Transport):
         self._wcursor += 1
 
     def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
-        deadline = time.time() + (timeout or 0.0)  # roclint: allow(raw-timing)
+        deadline = time.time() + (timeout or 0.0)  # roclint: allow(raw-timing) — socket deadline on the wall clock matching the seal stamps
         while True:
             path = self._path(self._rcursor)
             if os.path.exists(path):
@@ -305,7 +314,7 @@ class FileTransport(Transport):
                     data = f.read()
                 self._rcursor += 1
                 return data
-            if time.time() >= deadline:  # roclint: allow(raw-timing)
+            if time.time() >= deadline:  # roclint: allow(raw-timing) — socket deadline check, same clock as the seal stamps
                 return None
             time.sleep(0.002)
 

@@ -50,7 +50,7 @@ from typing import Callable, List, Optional
 from roc_tpu import obs
 from roc_tpu.fleet.replica import Replica
 from roc_tpu.fleet.replog import ReplicationLog, SegmentGapError
-from roc_tpu.serve.queue import Overloaded
+from roc_tpu.serve.queue import Closed, Overloaded
 
 __all__ = ["FleetOverloaded", "FleetRouter"]
 
@@ -148,7 +148,10 @@ class FleetRouter:
                 break
             try:
                 fut = rep.submit(node_ids, deadline_s=deadline_s)
-            except Overloaded:
+            except (Overloaded, Closed):
+                # Overloaded: replica shed at its depth cap.  Closed: it
+                # raced a kill/close between eligibility and submit.
+                # Both re-route to the next-least-loaded sibling.
                 tried += 1
                 self.sibling_retries += 1
                 continue

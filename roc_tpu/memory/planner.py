@@ -108,8 +108,8 @@ class MemPlan:
             "layer_names": list(self.layer_names),
             # serialized plan fields, not new prediction sites — the plan's
             # predictions are ledgered where they are made (bench stamping)
-            "predicted_peak_bytes": self.predicted_peak_bytes,  # roclint: allow(unledgered-prediction)
-            "predicted_step_s": round(self.predicted_step_s, 9),  # roclint: allow(unledgered-prediction)
+            "predicted_peak_bytes": self.predicted_peak_bytes,  # roclint: allow(unledgered-prediction) — serialized plan field; the prediction is ledgered at bench stamping
+            "predicted_step_s": round(self.predicted_step_s, 9),  # roclint: allow(unledgered-prediction) — serialized plan field; the prediction is ledgered at bench stamping
             "keep_peak_bytes": self.keep_peak_bytes,
             "keep_step_s": round(self.keep_step_s, 9),
             "remat_peak_bytes": self.remat_peak_bytes,

@@ -342,6 +342,6 @@ def seed_for_graph(num_rows: int, num_edges: int,
     except (OSError, ValueError, KeyError, ImportError):
         # seeding is strictly best-effort: no budgets file / unpinned
         # shape degrades to measured-epoch warmup, the documented
-        # fallback, not an error  # roclint: allow(silent-swallow)
+        # fallback, not an error  # roclint: allow(silent-swallow) — documented best-effort seeding fallback, not an error path
         pass
     return None

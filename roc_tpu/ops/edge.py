@@ -163,7 +163,7 @@ def _chunked_gat_attend(h, table, edge_src, edge_dst, num_nodes: int,
     z0 = _vary_like(jnp.zeros((num_nodes + 1, K), as_t.dtype), as_t)
     o0 = _vary_like(jnp.zeros((num_nodes + 1, K, F), h.dtype), h)
     (z, out), _ = jax.lax.scan(  # scan-body remat, not an activation plan:
-        # residuals here would be O(E) per chunk  # roclint: allow(remat)
+        # residuals here would be O(E) per chunk  # roclint: allow(remat) — scan-body remat; residuals would be O(E) per chunk
         jax.checkpoint(acc_body, prevent_cse=False), (z0, o0), (src, dst))
     # _Z_GUARD (rationale at its definition above): edgeless rows would
     # otherwise hit 0/0 in fwd or 0 * inf in the division transpose (live

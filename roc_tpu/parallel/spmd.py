@@ -989,7 +989,7 @@ def _ring_attend(gd_block, S: int, h, a_src, a_dst, slope: float):
     z0 = jax.lax.pcast(jnp.zeros((S, K)), PARTS_AXIS, to="varying")
     u0 = jax.lax.pcast(jnp.zeros((S, K, F)), PARTS_AXIS, to="varying")
     (_, _, z, u), _ = jax.lax.scan(  # ring-step remat keeps the rotating
-        # buffer out of the residual set  # roclint: allow(remat)
+        # buffer out of the residual set  # roclint: allow(remat) — ring-step remat keeps the rotating buffer out of the residual set
         jax.checkpoint(step, prevent_cse=False),
         (_wire_down(h, gd_block), m0, z0, u0), jnp.arange(P_))
     # _Z_GUARD (ops/edge.py): big enough to survive BOTH the XLA

@@ -50,7 +50,7 @@ def selftest() -> int:
     checkpoint.save(ckpt, trainer.params, trainer.opt_state, trainer.epoch,
                     trainer.optimizer.alpha)
     # the parity oracle is fetched once, before serving starts
-    oracle = np.asarray(trainer.predict_logits())  # roclint: allow(host-sync)
+    oracle = np.asarray(trainer.predict_logits())  # roclint: allow(host-sync) — parity oracle fetched once, before serving starts
     del trainer
 
     # -- cold start from the warm cache

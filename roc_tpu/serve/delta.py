@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from roc_tpu import fault, obs
+from roc_tpu.analysis import witness as _witness
 from roc_tpu.graph.csr import from_edges
 from roc_tpu.ops.pallas import binned
 from roc_tpu.train import checkpoint as _ckpt
@@ -270,9 +271,9 @@ class _PlanPatcher:
                 f"bpg={plan.bins_per_group}); refusing the patch path")
         # np.asarray on resident plan buffers is the enable-time host
         # copy, outside any traced code
-        self.p1 = np.asarray(plan.p1_srcl).reshape(G, -1).astype(  # roclint: allow(host-sync)
+        self.p1 = np.asarray(plan.p1_srcl).reshape(G, -1).astype(  # roclint: allow(host-sync) — enable-time host copy of resident plan buffers, untraced
             np.int32).copy()
-        self.p2 = np.asarray(plan.p2_dstl).reshape(G, -1).astype(  # roclint: allow(host-sync)
+        self.p2 = np.asarray(plan.p2_dstl).reshape(G, -1).astype(  # roclint: allow(host-sync) — enable-time host copy of resident plan buffers, untraced
             np.int32).copy()
         cells = lay.cells_of(base_src, base_dst)
         if (cells < 0).any():
@@ -317,7 +318,7 @@ class _PlanPatcher:
         for ci, lst in touched.items():
             self.members[ci] = lst
             s, d = self.orient(
-                np.asarray([store_src[g] for g in lst], np.int64),  # roclint: allow(host-sync)
+                np.asarray([store_src[g] for g in lst], np.int64),  # roclint: allow(host-sync) — host-side cell regrouping over python lists, untraced
                 np.asarray([store_dst[g] for g in lst], np.int64))  # roclint: allow(host-sync) — host edge store, no device array
             binned.patch_plan_cells(self.layout, self.p1, self.p2,
                                     ci, s, d)
@@ -330,7 +331,7 @@ class _PlanPatcher:
         p1, p2 = binned.empty_cell_arrays(self.layout)
         for ci, lst in enumerate(self.members):
             s, d = self.orient(
-                np.asarray([store_src[g] for g in lst], np.int64),  # roclint: allow(host-sync)
+                np.asarray([store_src[g] for g in lst], np.int64),  # roclint: allow(host-sync) — host-side cell regrouping over python lists, untraced
                 np.asarray([store_dst[g] for g in lst], np.int64))  # roclint: allow(host-sync) — host edge store, no device array
             binned.patch_plan_cells(self.layout, p1, p2, ci, s, d)
         return p1, p2
@@ -391,7 +392,7 @@ class DeltaManager:
         self.verbose = verbose
         self._ledger_key = ledger_key or obs.ledger.content_key(
             model="delta", nodes=num_nodes)
-        self._mu = threading.Lock()
+        self._mu = _witness.trace("DeltaManager._mu", threading.Lock())
         self._ticket: Optional[_ReplanTicket] = None
         self._replan_thread: Optional[threading.Thread] = None
         self._broken: Optional[BaseException] = None
@@ -404,9 +405,9 @@ class DeltaManager:
         self._check_supported(gd)
         # frozen-artifact base: the edge list the resident plans were
         # built from (enable-time host copy, outside any traced code)
-        base_src = np.asarray(gd.edge_src, np.int64)  # roclint: allow(host-sync)
-        base_dst = np.asarray(gd.edge_dst, np.int64)  # roclint: allow(host-sync)
-        in_deg = np.rint(np.asarray(gd.in_degree)).astype(np.int64)  # roclint: allow(host-sync)
+        base_src = np.asarray(gd.edge_src, np.int64)  # roclint: allow(host-sync) — enable-time host copy of the frozen edge list
+        base_dst = np.asarray(gd.edge_dst, np.int64)  # roclint: allow(host-sync) — enable-time host copy of the frozen edge list
+        in_deg = np.rint(np.asarray(gd.in_degree)).astype(np.int64)  # roclint: allow(host-sync) — enable-time host copy of the frozen edge list
 
         self.journal = DeltaJournal(journal_path) if journal_path else None
         self._snap_path = (journal_path + ".snapshot.npz"
@@ -511,9 +512,9 @@ class DeltaManager:
             # reconstructing the EXACT geometry the snapshot's plans were
             # built with — consulting the tuned tier here could disagree
             # with the journaled state and break replay parity
-            # roclint: allow(hand-rolled-geometry)
+            # roclint: allow(hand-rolled-geometry) — journaled geometry must replay bit-identically; the tuned tier could disagree
             gf = binned.Geometry(*extra["geom_fwd"])
-            # roclint: allow(hand-rolled-geometry)
+            # roclint: allow(hand-rolled-geometry) — journaled geometry must replay bit-identically; the tuned tier could disagree
             gb = binned.Geometry(*extra["geom_bwd"])
             fwd = _strip_fused(binned.build_binned_plan(
                 base_src, base_dst, gd.plans.fwd.num_rows,
@@ -577,6 +578,7 @@ class DeltaManager:
             if self._ticket is not None and not self._ticket.done:
                 # a replan is in flight: the OLD plan serves queries,
                 # but mutations serialize behind the swap
+                # roclint: allow(lock-blocking) — mutations MUST serialize behind the in-flight replan under _mu; queries never take _mu, so serving stays live
                 self._ticket.wait()
             if self._ticket is not None:
                 if self._ticket.error is not None:
@@ -586,6 +588,7 @@ class DeltaManager:
                 self._ticket = None
             add = self._validate(add_edges, "add_edges")
             ret = self._validate(retire_edges, "retire_edges")
+            # roclint: allow(lock-blocking) — pre-WAL chaos site: a kill here unwinds through `with _mu` releasing it, and the journal has not advanced, so restart replays cleanly
             fault.point("delta.apply")   # transient chaos: reject pre-WAL
             eff_add, eff_ret, noop_add, noop_ret = self._classify(add, ret)
             self.counters["noop_adds"] += noop_add
@@ -606,10 +609,12 @@ class DeltaManager:
                         "cells_patched": 0}
             seq = self._seq + 1
             if self.journal is not None and not self._replaying:
+                # roclint: allow(lock-blocking) — WAL-before-memory IS the commit point: the fsync'd append must complete under _mu or a racing apply could journal seq+1 before seq is durable
                 self.journal.append(seq, add, ret)
             try:
                 with obs.span("delta_apply", adds=len(eff_add),
                               retires=len(eff_ret)) as sp:
+                    # roclint: allow(lock-blocking) — the in-memory commit matching the WAL record above; it reaches kill windows and checkpoint fsync by design, and a crash inside poisons the manager for replay
                     result = self._apply_effective(seq, eff_add, eff_ret)
             except BaseException as e:
                 # past the WAL: a failure here leaves memory behind the
@@ -785,11 +790,13 @@ class DeltaManager:
             in_deg = self._in_deg
             ind = jnp.asarray(in_deg, jnp.float32)
             with self._plan_lock:
+                # roclint: allow(lock-blocking) — the swap kill windows sit INSIDE the plan lock on purpose: the crash-consistency drill proves a kill at either edge of the atomic swap unwinds (releasing the lock via `with`) without serving a torn plan
                 fault.point("delta.swap.kill_pre")
                 gd = self._get_gdata()
                 self._set_gdata(dataclasses.replace(
                     gd, plans=gd.plans._replace(fwd=fwd, bwd=bwd),
                     in_degree=ind))
+                # roclint: allow(lock-blocking) — see kill_pre above: same sanctioned kill window, post-swap edge
                 fault.point("delta.swap.kill_post")
             self._fwd, self._bwd = pf, pb
             self._fwd_plan, self._bwd_plan = fwd, bwd
@@ -878,11 +885,13 @@ class DeltaManager:
                 return
             self._closed = True
             if self._ticket is not None and not self._ticket.done:
+                # roclint: allow(lock-blocking) — close() is finish-or-journal: holding _mu while the last replan drains keeps a racing apply() from slipping a mutation into a closing manager
                 self._ticket.wait()
             if self._replan_thread is not None:
                 # the ticket resolves in the worker's finally; join past
                 # it so process exit never tears down the runtime under
                 # a thread still unwinding device code
+                # roclint: allow(lock-blocking) — same close() barrier: the replan worker never takes _mu, so joining it under _mu cannot deadlock, and it must be dead before the journal closes
                 self._replan_thread.join(timeout=60.0)
                 self._replan_thread = None
             if self.journal is not None:

@@ -47,6 +47,7 @@ import numpy as np
 
 from roc_tpu import fault, obs
 from roc_tpu.analysis import retrace as _retrace
+from roc_tpu.analysis import witness as _witness
 from roc_tpu.graph.datasets import Dataset
 from roc_tpu.models.model import Model
 from roc_tpu.serve.queue import MicrobatchQueue, ServeFuture
@@ -86,7 +87,8 @@ class ServeEngine:
         self._p99_windows = 0
         # Serve worker holds this for a whole window; delta installs and
         # the replan swap take it — atomic swap at a window boundary.
-        self._plan_lock = threading.RLock()
+        self._plan_lock = _witness.trace("ServeEngine._plan_lock",
+                                         threading.RLock())
         self.deltas = None
         # The engine's own trace counter: note_trace("serve_step") fires
         # only while jax is tracing, so the guard's counts ARE the trace
@@ -200,7 +202,7 @@ class ServeEngine:
                 # out-of-core: one slot sweep per window, gather on host.
                 # This is the window's ONE sanctioned batch-boundary sync.
                 logits = self.bundle.predict_logits()
-                out = np.asarray(logits)[ids]  # roclint: allow(host-sync)
+                out = np.asarray(logits)[ids]  # roclint: allow(host-sync) — the window's ONE sanctioned batch-boundary sync
             else:
                 parts = []
                 cap = self.buckets[-1]
@@ -215,7 +217,7 @@ class ServeEngine:
                         jnp.asarray(qidx))
                     # the window's ONE sanctioned batch-boundary sync:
                     # exactly one result fetch per dispatched chunk
-                    res = np.asarray(res)  # roclint: allow(host-sync)
+                    res = np.asarray(res)  # roclint: allow(host-sync) — one result fetch per dispatched chunk — the sanctioned window sync
                     parts.append(res[:chunk.size])
                 out = parts[0] if len(parts) == 1 else np.concatenate(parts)
         del sp

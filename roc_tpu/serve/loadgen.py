@@ -44,7 +44,7 @@ def run_load(engine, n_requests: int, qps: float,
     # Open-loop schedule anchor: each request fires at t0 + i/qps on the
     # host clock.  obs spans time device work, not an offer schedule (and
     # the submit side must never sync), hence the documented waiver.
-    t0 = time.perf_counter()  # roclint: allow(raw-timing)
+    t0 = time.perf_counter()  # roclint: allow(raw-timing) — open-loop offer schedule anchor; the submit side must never sync
     for i in range(n_requests):
         target = t0 + i / qps
         delay = target - time.perf_counter()

@@ -32,8 +32,8 @@ def max_ulp_diff(a, b) -> int:
     """
     # The parity gate runs off the request path (tests / selftest only),
     # so pulling both operands to the host is its job, not a leak.
-    a = np.asarray(a, np.float32)  # roclint: allow(host-sync)
-    b = np.asarray(b, np.float32)  # roclint: allow(host-sync)
+    a = np.asarray(a, np.float32)  # roclint: allow(host-sync) — off-request-path parity gate; the host pull is its job
+    b = np.asarray(b, np.float32)  # roclint: allow(host-sync) — off-request-path parity gate; the host pull is its job
     assert a.shape == b.shape, f"shape mismatch: {a.shape} vs {b.shape}"
     nan_a, nan_b = np.isnan(a), np.isnan(b)
     if (nan_a != nan_b).any():

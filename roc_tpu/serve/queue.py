@@ -27,6 +27,8 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
+from roc_tpu.analysis import witness as _witness
+
 
 class Overloaded(RuntimeError):
     """Typed shed signal: the serve queue refused or dropped a request
@@ -34,6 +36,15 @@ class Overloaded(RuntimeError):
     a per-request deadline expired before its window drained).  Callers
     distinguish this from a serving *failure* — the correct client
     reaction is backoff/re-route, not a bug report."""
+
+
+class Closed(RuntimeError):
+    """Typed lifecycle signal: the request raced a deliberate shutdown
+    (submit after ``close()``, or closed before this request's window
+    drained).  Like :class:`Overloaded` this is not a serving failure —
+    the fleet router treats it as \"re-route to a live sibling\", and a
+    kill-drill replica dying mid-submit surfaces as this, never as an
+    anonymous RuntimeError."""
 
 
 class ServeFuture:
@@ -105,7 +116,8 @@ class MicrobatchQueue:
         self._on_window = on_window
         self._queue_max = int(queue_max)
         self._pending: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = _witness.trace("MicrobatchQueue._cv",
+                                  threading.Condition())
         self._closed = False
         self.windows = 0
         self.served = 0
@@ -124,12 +136,12 @@ class MicrobatchQueue:
         # request ingress: caller's id list -> host array.  Nothing device-
         # resident is touched here, but the serve host-sync lint rule has
         # no type information, so the conversion carries a waiver.
-        ids = np.asarray(node_ids, np.int32).reshape(-1)  # roclint: allow(host-sync)
+        ids = np.asarray(node_ids, np.int32).reshape(-1)  # roclint: allow(host-sync) — request ingress, host list to host array, nothing device-resident
         assert ids.size >= 1, "empty query"
         fut = ServeFuture(ids, deadline_s=deadline_s)
         with self._cv:
             if self._closed:
-                raise RuntimeError("queue closed")
+                raise Closed("queue closed")
             if self._queue_max and len(self._pending) >= self._queue_max:
                 self.shed += 1
                 raise Overloaded(
@@ -163,8 +175,8 @@ class MicrobatchQueue:
         with self._cv:
             leftover = list(self._pending)
             self._pending.clear()
-        err = RuntimeError("serve queue closed before this request "
-                          "was served")
+        err = Closed("serve queue closed before this request "
+                     "was served")
         for f in leftover:
             if not f.done():
                 f._resolve(error=err)

@@ -25,6 +25,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, Hashable, Tuple
 
 from roc_tpu import fault, obs
+from roc_tpu.analysis import witness as _witness
 
 __all__ = ["PrefetchRing"]
 
@@ -40,7 +41,11 @@ class PrefetchRing:
         self._pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="roc-stream-prefetch")
         self._futures: Dict[Hashable, Future] = {}
-        self._lock = threading.Lock()
+        self._lock = _witness.trace("PrefetchRing._lock", threading.Lock())
+        # stall_s/busy_s are written from two threads (consumer vs.
+        # worker) — every access goes through _lock; float += is NOT
+        # atomic under the interpreter and a torn update here skews the
+        # watchdog's overlap EWMA silently.
         self.stall_s = 0.0   # consumer time blocked on incomplete fetches
         self.busy_s = 0.0    # worker time spent gathering + transferring
 
@@ -59,7 +64,8 @@ class PrefetchRing:
         with obs.span("stream_prefetch", item=str(item)) as sp:
             out = fault.retrying("ring.fetch", _attempt,
                                  retry_on=(OSError, RuntimeError))
-        self.busy_s += sp.dur_s
+        with self._lock:
+            self.busy_s += sp.dur_s
         return out
 
     # -- consumer side ------------------------------------------------------
@@ -86,7 +92,8 @@ class PrefetchRing:
         if not fut.done():
             with obs.span("stream_wait", item=str(item)) as sp:
                 out = fut.result()
-            self.stall_s += sp.dur_s
+            with self._lock:
+                self.stall_s += sp.dur_s
             return out
         return fut.result()
 
@@ -112,14 +119,17 @@ class PrefetchRing:
     # -- epoch stats --------------------------------------------------------
 
     def reset_epoch_stats(self) -> None:
-        self.stall_s = 0.0
-        self.busy_s = 0.0
+        with self._lock:
+            self.stall_s = 0.0
+            self.busy_s = 0.0
 
     def epoch_stats(self) -> Dict[str, float]:
-        overlap = 1.0 - self.stall_s / max(self.busy_s, 1e-12)
+        with self._lock:
+            stall, busy = self.stall_s, self.busy_s
+        overlap = 1.0 - stall / max(busy, 1e-12)
         return {
-            "stall_s": self.stall_s,
-            "transfer_s": self.busy_s,
+            "stall_s": stall,
+            "transfer_s": busy,
             "overlap_frac": min(max(overlap, 0.0), 1.0),
         }
 

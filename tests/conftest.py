@@ -92,3 +92,21 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture
+def lock_witness():
+    """Arm the runtime lock-order witness for one test: locks created
+    inside the test become recording proxies, and at teardown every
+    observed (outer, inner) acquisition pair must be an edge of the
+    static graph in roc_tpu/analysis/threads.json.  The threaded suites
+    (serve/delta/stream/fleet) wrap this in an autouse fixture, which is
+    what pins the analyzer sound against reality, not just fixtures."""
+    from roc_tpu.analysis import witness
+    witness.reset()
+    witness.arm(True)
+    yield witness
+    violations = witness.validate()
+    witness.arm(False)
+    witness.reset()
+    assert violations == [], violations

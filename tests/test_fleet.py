@@ -30,6 +30,7 @@ layer where they are cheap to pin (the full ServeEngine fleet drill is
 
 import struct
 import threading
+import time
 import warnings
 import zlib
 
@@ -50,9 +51,16 @@ from roc_tpu.graph.csr import from_edges
 from roc_tpu.obs.watchdog import PerfWatchdog
 from roc_tpu.ops.aggregate import BinnedPlans
 from roc_tpu.ops.pallas import binned
-from roc_tpu.serve.delta import _LEN, _REC, DeltaManager
-from roc_tpu.serve.queue import Overloaded
+from roc_tpu.serve.delta import _LEN, _REC, DeltaError, DeltaManager
+from roc_tpu.serve.queue import Closed, Overloaded
 from roc_tpu.train.driver import DenseGraphData
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_witness):
+    # every fleet test runs under the armed lock-order witness; any
+    # acquisition order outside threads.json fails at teardown
+    yield
 
 
 # -- fixtures (same graph discipline as tests/test_delta.py) ----------------
@@ -678,3 +686,168 @@ def test_watchdog_fleet_state_roundtrip():
     assert wd2.fleet_observed == wd.fleet_observed
     # a restored watchdog is armed: no re-warming after resume
     assert wd2.observe_fleet(4, 1.0) is not None
+
+
+# -- shutdown races: predicate loops, typed Closed, pump/kill/close chaos ----
+
+def test_inproc_recv_survives_spurious_wakeup():
+    """Regression for the recv predicate loop: a notify with no data
+    behind it (stolen wakeup) must neither return None early nor eat
+    the caller's deadline budget — recv re-arms against the remaining
+    time and still collects the late segment."""
+    tr = InProcTransport()
+    try:
+        def _spurious():
+            time.sleep(0.05)
+            with tr._cv:               # wake the waiter with nothing queued
+                tr._cv.notify_all()
+
+        def _sender():
+            time.sleep(0.2)
+            tr.send(b"real")
+
+        ts = [threading.Thread(target=_spurious),
+              threading.Thread(target=_sender)]
+        for t in ts:
+            t.start()
+        assert tr.recv(10.0) == b"real"
+        for t in ts:
+            t.join()
+        # drained: the deadline is honored instead of hanging forever
+        assert tr.recv(0.05) is None
+    finally:
+        tr.close()
+
+
+class _ClosedReplica(_StubReplica):
+    """A replica whose queue raced close() between eligibility and
+    submit — the exact window the Closed taxonomy exists for."""
+
+    def submit(self, node_ids, deadline_s=None):
+        raise Closed(f"{self.name} queue closed")
+
+
+def test_router_reroutes_closed_replica_to_sibling():
+    p = _StubReplica("p", load=5)
+    dead = _ClosedReplica("dead", load=0)    # least-loaded, but closing
+    r = _stub_router(p, [dead])
+    fut = r.submit([1, 2])
+    assert fut[0] == "p"                     # absorbed, not surfaced
+    assert r.sibling_retries == 1 and r.shed == 0
+    # Closed subclasses RuntimeError: pre-taxonomy callers still catch it
+    assert issubclass(Closed, RuntimeError)
+
+
+def _real_fleet(tmp_path, n_followers=2):
+    from roc_tpu.fleet.replica import Replica
+    from roc_tpu.graph import datasets
+    from roc_tpu.models import build_model
+    from roc_tpu.train.config import Config
+
+    ds = datasets.get("roc-audit", seed=1)
+    cfg = Config(layers=[ds.in_dim, 8, ds.num_classes], dropout_rate=0.0,
+                 eval_every=10**9, serve_batch=4, serve_wait_ms=1.0,
+                 aggregate_backend="binned", aggregate_precision="exact")
+    model = build_model("gcn", cfg.layers, cfg.dropout_rate, cfg.aggr)
+
+    def mk(name):
+        return Replica(name, cfg, ds, model, None,
+                       str(tmp_path / f"{name}.wal"))
+
+    primary = mk("primary")
+    replog = ReplicationLog(primary.engine)
+    followers = []
+    for i in range(n_followers):
+        f = mk(f"f{i}")
+        f.transport = replog.attach(InProcTransport())
+        followers.append(f)
+    router = FleetRouter(primary, followers, replog)
+    return ds, router, primary, followers
+
+
+def test_fleet_pump_kill_close_chaos(tmp_path):
+    """Seeded shutdown race over a REAL three-engine fleet: query and
+    mutation traffic runs concurrently with pump(), then one follower
+    dies hard (seeded ``fleet.replica.kill``) and another's engine is
+    close()d under the router's feet.  The contract: every error any
+    thread observes is typed (Closed / Overloaded / FleetOverloaded /
+    DeltaError / TimeoutError / SimulatedCrash), the fleet keeps
+    serving through the survivors, no thread deadlocks, and the armed
+    lock-order witness (autouse fixture) sees zero acquisition orders
+    outside threads.json."""
+    ds, router, primary, (f1, f2) = _real_fleet(tmp_path)
+    n = ds.graph.num_nodes
+    for rep in router.replicas:
+        rep.engine.warmup()                  # compile outside the race
+    stop = threading.Event()
+    surprises = []
+
+    def _guarded(fn, typed, seed):
+        def run():
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    fn(rng)
+                except typed:
+                    pass
+                except BaseException as e:   # SimulatedCrash is a BaseException
+                    surprises.append(repr(e))
+                    return
+        return threading.Thread(target=run)
+
+    def _query(rng):
+        k = int(rng.integers(1, 5))
+        ids = [int(i) for i in rng.integers(0, n, size=k)]
+        out = router.query(ids, timeout=10.0)
+        assert out.shape == (k, ds.num_classes)
+
+    def _pump(rng):
+        router.pump(0.0)
+        time.sleep(0.002)
+
+    def _mutate(rng):
+        a, b = int(rng.integers(0, n)), int(rng.integers(0, n))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            router.apply_delta(np.asarray([[a, b]]), None, pump=False)
+        time.sleep(0.01)
+
+    threads = [
+        _guarded(_query, (FleetOverloaded, Overloaded, Closed,
+                          TimeoutError), 7),
+        _guarded(_query, (FleetOverloaded, Overloaded, Closed,
+                          TimeoutError), 11),
+        _guarded(_pump, (SegmentGapError, ReplicationError, DeltaError,
+                         Closed), 13),
+        _guarded(_mutate, (DeltaError, Closed), 17),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)                      # steady-state traffic
+        inject.configure("seed=3,fleet.replica.kill=1")
+        try:
+            with pytest.raises(inject.SimulatedCrash):
+                f1.kill()                    # hard death: no drain, no close
+        finally:
+            inject.configure("")
+        assert not f1.alive
+        time.sleep(0.15)
+        f2.engine.close()                    # close under the router's feet:
+        time.sleep(0.15)                     # racing submits surface Closed
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+    assert not any(t.is_alive() for t in threads), "a stress thread hung"
+    assert surprises == [], surprises
+    # the fleet still serves through the primary after the carnage
+    out = router.query([0, 1, 2], timeout=10.0)
+    assert out.shape == (3, ds.num_classes)
+    assert np.all(np.isfinite(out))
+    # cleanup: join the hard-killed replica's abandoned engine and the
+    # half-closed follower, then the primary
+    f1.engine.close()
+    f2.alive = False
+    f2.close()
+    primary.close()

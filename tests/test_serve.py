@@ -35,6 +35,13 @@ from roc_tpu.serve.loadgen import percentile
 from roc_tpu.train.config import Config
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_witness):
+    # every serve test runs under the armed lock-order witness; any
+    # acquisition order outside threads.json fails at teardown
+    yield
+
+
 def _engine(ds, *, model="gcn", backend="matmul", megafuse=False,
             bf16_storage=False, heads=2, start_queue=False, serve_batch=8,
             serve_wait_ms=1.0, precision="fast"):
@@ -269,12 +276,18 @@ def test_queue_resolves_errors_without_dying():
 
 
 def test_queue_rejects_empty_and_closed():
+    from roc_tpu.serve.queue import Closed
     q = MicrobatchQueue(_echo_serve, batch=2, wait_ms=1.0)
     with pytest.raises(AssertionError):
         q.submit([])
     q.close()
-    with pytest.raises(RuntimeError):
+    # submit-after-close is TYPED: the fleet router tells this lifecycle
+    # signal ("re-route to a sibling") apart from a depth-cap Overloaded
+    with pytest.raises(Closed):
         q.submit([1])
+    # ... while pre-taxonomy callers catching RuntimeError still work
+    assert issubclass(Closed, RuntimeError)
+    q.close()                        # idempotent: double close is a no-op
 
 
 # -- load generator --------------------------------------------------------

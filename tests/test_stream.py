@@ -13,6 +13,9 @@ The contract under test mirrors ISSUE 9's acceptance gates:
   ranges on every reshard, so these guards run in the hot loop's setup).
 """
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -23,6 +26,13 @@ from roc_tpu.models import build_model
 from roc_tpu.stream import incore_resident_bytes
 from roc_tpu.train.config import Config
 from roc_tpu.train.driver import make_trainer
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(lock_witness):
+    # every stream test runs under the armed lock-order witness; any
+    # acquisition order outside threads.json fails at teardown
+    yield
 
 
 def _trainer(ds, *, model="gcn", num_parts=1, stream=False, epochs=3,
@@ -154,3 +164,60 @@ def test_frozen_shapes_reject_oversized_cut(lux_graph):
     with pytest.raises(ValueError, match="cannot hold"):
         shard_load.meta_from_lux(path, 2, bounds=[(0, n - 2), (n - 1, n - 1)],
                                  shard_nodes=8)
+
+
+# -- prefetch-ring stats under the lock (regression: torn float +=) ---------
+
+def test_ring_stats_consistent_under_concurrent_readers():
+    """busy_s/stall_s are written by the worker and the consumer and
+    read by epoch_stats() from anywhere; all three now go through
+    _lock.  Regression for the torn-update race: hammer fetches from
+    several consumer threads while readers snapshot/reset, and require
+    every snapshot internally consistent (finite, non-negative, overlap
+    clamped) and the final busy_s to have absorbed every fetch."""
+    from roc_tpu.stream.ring import PrefetchRing
+
+    fetched = []
+
+    def fetch(item):
+        time.sleep(0.001)
+        fetched.append(item)
+        return item
+
+    ring = PrefetchRing(4, fetch)
+    bad = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            st = ring.epoch_stats()
+            if not (np.isfinite(st["stall_s"]) and st["stall_s"] >= 0.0
+                    and np.isfinite(st["transfer_s"])
+                    and st["transfer_s"] >= 0.0
+                    and 0.0 <= st["overlap_frac"] <= 1.0):
+                bad.append(st)
+                return
+
+    def consumer(base):
+        for i in range(24):
+            assert ring.wait(("item", base, i)) == ("item", base, i)
+
+    try:
+        rt = threading.Thread(target=reader)
+        cs = [threading.Thread(target=consumer, args=(b,)) for b in range(3)]
+        rt.start()
+        for t in cs:
+            t.start()
+        for t in cs:
+            t.join(60.0)
+        stop.set()
+        rt.join(10.0)
+        assert not rt.is_alive() and not any(t.is_alive() for t in cs)
+        assert bad == [], bad
+        assert len(fetched) == 3 * 24
+        # the worker's increments all landed: busy_s covers every fetch
+        assert ring.epoch_stats()["transfer_s"] >= 3 * 24 * 0.001
+        ring.reset_epoch_stats()
+        assert ring.epoch_stats()["transfer_s"] == 0.0
+    finally:
+        ring.close()

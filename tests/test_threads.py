@@ -1,0 +1,259 @@
+"""roc-threads tests: lock-discipline analyzer + runtime witness.
+
+Mirrors test_analysis.py's evidence pattern for roc-verify:
+  * the tree is CLEAN against the committed threads.json (no findings
+    after reasoned waivers, zero baseline drift);
+  * seeded mutations — a lock inversion, a dropped guard, a waitless
+    condvar wait, an unjoined thread, a lock held across fsync, a
+    mislabeled witness name — are each caught (the analyzer provably
+    bites, it does not just bless);
+  * the runtime witness records real acquisition orders when armed,
+    validates them against the static graph (transitive closure), is a
+    zero-record passthrough when disarmed, and ships `lock_order`
+    events into the fault/obs telemetry sink;
+  * every `# roclint: allow(...)` waiver in the tree carries a reason.
+
+The threaded suites (test_serve/test_delta/test_stream/test_fleet) run
+each test under the armed witness via an autouse fixture; the stress
+cases there are what pin the graph against reality — this file pins the
+machinery itself.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from roc_tpu.analysis import threads as T
+from roc_tpu.analysis import witness as W
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the tree against its committed baseline --------------------------------
+
+@pytest.fixture(scope="module")
+def tree_report():
+    return T.analyze_paths([os.path.join(ROOT, "roc_tpu")])
+
+
+def test_tree_is_clean_under_waivers(tree_report):
+    assert tree_report.findings == [], [str(f) for f in tree_report.findings]
+
+
+def test_tree_matches_committed_baseline():
+    # analyze with repo-relative paths so LockNode.path matches what
+    # --update-threads committed
+    os.chdir(ROOT)
+    rep = T.analyze_paths(["roc_tpu"])
+    drift = T.diff_baseline(rep)
+    assert drift == [], "\n".join(drift)
+
+
+def test_baseline_pins_the_known_discipline():
+    base = T.load_baseline()
+    edges = {tuple(e) for e in base["edges"]}
+    # the two real cross-lock orders in the tree today
+    assert ("DeltaManager._mu", "ServeEngine._plan_lock") in edges
+    assert ("ServeEngine._plan_lock", "PrefetchRing._lock") in edges
+    # declared edges carry reasons
+    for a, b, reason in base["declared_edges"]:
+        assert reason.strip(), f"declared edge {a}->{b} missing a reason"
+    # the load-bearing guarded-by facts
+    gb = base["guarded_by"]
+    assert gb["MicrobatchQueue._pending"] == "MicrobatchQueue._cv"
+    assert gb["DeltaManager._seq"] == "DeltaManager._mu"
+    assert gb["PrefetchRing.stall_s"] == "PrefetchRing._lock"
+    assert gb["InProcTransport._q"] == "InProcTransport._cv"
+    # every production lock the witness wraps is named correctly
+    wrapped = {lk["name"]: lk["witness"] for lk in base["locks"]
+               if lk["witness"] is not None}
+    assert wrapped == {
+        "DeltaManager._mu": "DeltaManager._mu",
+        "InProcTransport._cv": "InProcTransport._cv",
+        "MicrobatchQueue._cv": "MicrobatchQueue._cv",
+        "PrefetchRing._lock": "PrefetchRing._lock",
+        "ServeEngine._plan_lock": "ServeEngine._plan_lock",
+    }
+    # spawned threads/pools are all joinable from close()
+    assert all(th["joined"] for th in base["threads"]), base["threads"]
+
+
+def test_baseline_json_is_deterministic(tmp_path):
+    os.chdir(ROOT)
+    rep = T.analyze_paths(["roc_tpu"])
+    p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+    T.save_baseline(rep, str(p1))
+    T.save_baseline(T.analyze_paths(["roc_tpu"]), str(p2))
+    assert p1.read_bytes() == p2.read_bytes()
+    assert json.loads(p1.read_text()) == T.report_dict(rep)
+
+
+# -- seeded mutations (the analyzer bites) ----------------------------------
+
+def _rules(src):
+    return {f.rule for f in T.analyze_source(src).findings}
+
+
+def test_clean_fixture_is_clean():
+    rep = T.analyze_source(T._FIX_CLEAN)
+    assert rep.findings == []
+    assert ("Worker.a", "Worker.b") in rep.edges
+    assert rep.guarded_by["Worker.items"] == "Worker.cv"
+
+
+def test_seeded_inversion_caught():
+    assert "lock-cycle" in _rules(T._MUT_INVERSION)
+
+
+def test_seeded_dropped_guard_caught():
+    assert "unguarded-attr" in _rules(T._MUT_UNGUARDED)
+
+
+def test_seeded_waitless_condvar_caught():
+    assert "condvar-wait" in _rules(T._MUT_WAITLESS)
+
+
+def test_seeded_unjoined_thread_caught():
+    assert "thread-join" in _rules(T._MUT_UNJOINED)
+
+
+def test_seeded_lock_across_fsync_caught():
+    assert "lock-blocking" in _rules(T._MUT_BLOCKING)
+
+
+def test_seeded_witness_name_mismatch_caught():
+    assert "witness-name" in _rules(T._MUT_WITNESS_NAME)
+
+
+def test_waiver_silences_exactly_its_rule():
+    waived = T._MUT_BLOCKING.replace(
+        "        with self.a:\n            os.fsync(0)",
+        "        with self.a:\n"
+        "            # roclint: allow(lock-blocking) — fixture reason\n"
+        "            os.fsync(0)")
+    rep = T.analyze_source(waived)
+    assert rep.findings == [] and rep.waived == 1
+    # the waiver must not bleed into other rules
+    assert "lock-cycle" in _rules(T._MUT_INVERSION.replace(
+        "with self.b:\n            with self.a:",
+        "with self.b:\n            # roclint: allow(lock-blocking) — wrong rule\n"
+        "            with self.a:"))
+
+
+def test_selftest_matrix_passes():
+    assert T.selftest(verbose=False) == 0
+
+
+# -- runtime witness mechanics ----------------------------------------------
+
+@pytest.fixture
+def armed():
+    was = W.armed()
+    W.reset()
+    W.arm(True)
+    yield W
+    W.arm(was)
+    W.reset()
+
+
+def test_disarmed_is_passthrough_with_zero_records():
+    was = W.armed()
+    W.arm(False)
+    try:
+        W.reset()
+        raw = threading.Lock()
+        assert W.trace("X.raw", raw) is raw          # zero overhead
+        with W.trace("X.a", threading.Lock()):
+            with W.trace("X.b", threading.Lock()):
+                pass
+        assert W.records() == 0                      # zero telemetry
+    finally:
+        W.arm(was)
+
+
+def test_armed_records_and_validates(armed):
+    a = armed.trace("X.a", threading.Lock())
+    b = armed.trace("X.b", threading.Lock())
+    with a:
+        with b:
+            pass
+    assert armed.observed_pairs()[("X.a", "X.b")] == 1
+    assert armed.validate(edges=[("X.a", "X.b")]) == []
+    viol = armed.validate(edges=[("X.b", "X.a")])
+    assert len(viol) == 1 and "X.a -> X.b" in viol[0]
+    # transitive closure: a->c->b sanctions the observed a->b
+    assert armed.validate(edges=[("X.a", "X.c"), ("X.c", "X.b")]) == []
+
+
+def test_armed_rlock_reentry_orders_nothing(armed):
+    r = armed.trace("X.r", threading.RLock())
+    inner = armed.trace("X.i", threading.Lock())
+    with r:
+        with r:            # re-entry: no (r, r) pair
+            with inner:
+                pass
+    pairs = armed.observed_pairs()
+    assert ("X.r", "X.r") not in pairs
+    assert pairs[("X.r", "X.i")] == 1
+
+
+def test_armed_condvar_wait_rerecords_order(armed):
+    cv = armed.trace("X.cv", threading.Condition())
+    outer = armed.trace("X.outer", threading.Lock())
+    with outer:
+        with cv:
+            cv.wait(timeout=0.01)   # drop + re-record under `outer`
+    pairs = armed.observed_pairs()
+    # recorded at first acquire AND again at wait's reacquisition
+    assert pairs[("X.outer", "X.cv")] == 2
+
+
+def test_witness_emits_lock_order_telemetry(armed):
+    from roc_tpu import fault
+    events = []
+    fault.attach(lambda kind, **f: events.append((kind, f)))
+    try:
+        a = armed.trace("X.t1", threading.Lock())
+        b = armed.trace("X.t2", threading.Lock())
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    finally:
+        fault.detach()
+    lock_events = [f for k, f in events if k == "lock_order"]
+    # each distinct pair ships exactly once, not once per acquisition
+    assert lock_events == [{"outer": "X.t1", "inner": "X.t2"}]
+
+
+def test_validate_defaults_to_committed_baseline(armed):
+    # the production edge, driven for real through witness-wrapped locks
+    a = armed.trace("DeltaManager._mu", threading.Lock())
+    b = armed.trace("ServeEngine._plan_lock", threading.RLock())
+    with a:
+        with b:
+            pass
+    assert armed.validate() == []    # reads threads.json
+    armed.reset()
+    with b:
+        with a:
+            pass
+    assert len(armed.validate()) == 1   # inverted: off-graph
+
+
+# -- waiver inventory --------------------------------------------------------
+
+def test_every_waiver_has_a_reason():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "roclint_tool", os.path.join(ROOT, "tools", "roclint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    os.chdir(ROOT)
+    rows = mod.list_waivers(["roc_tpu", "tools", "bench.py"])
+    assert rows, "waiver inventory came back empty — walker broke"
+    missing = [(p, ln, rules) for p, ln, rules, reason in rows
+               if not reason]
+    assert missing == [], missing

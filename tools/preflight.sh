@@ -27,6 +27,18 @@ python tools/roclint.py || {
 echo "== budget audit =="
 timeout -k 10 600 python tools/roclint.py --audit --no-lint || {
     echo "preflight: collective budget audit RED" >&2; exit 1; }
+# Lock-discipline gate: the whole-tree concurrency analyzer must report
+# zero findings (after reasoned waivers) and zero drift against the
+# committed threads.json lock-order baseline (exit 3 on either).
+# Regenerate DELIBERATE discipline changes with --update-threads and
+# review the diff; the analyzer's own seeded-mutation matrix (inversion,
+# dropped guard, waitless condvar, ...) must keep biting.
+echo "== lock discipline =="
+timeout -k 10 120 python tools/roclint.py --threads --no-lint || {
+    echo "preflight: lock discipline RED (threads findings or baseline drift)" >&2; exit 3; }
+echo "== threads selftest =="
+timeout -k 10 120 python -m roc_tpu.analysis.threads --selftest || {
+    echo "preflight: threads analyzer selftest RED" >&2; exit 1; }
 # Kernel step budgets: predicted binned grid-step counts at the canonical
 # shapes must match tools/kernel_budgets.json exactly, and the flat
 # schedule must hold its >=25% step reduction over the shipped default.

@@ -4,6 +4,11 @@
     python tools/roclint.py [paths...]        AST lint (default: the tree)
     python tools/roclint.py --audit           collective budget audit
     python tools/roclint.py --update-budgets  regenerate budgets.json
+    python tools/roclint.py --threads         lock-discipline analysis +
+                                              exact-diff vs threads.json
+    python tools/roclint.py --update-threads  regenerate threads.json
+    python tools/roclint.py --list-waivers    inventory every roclint
+                                              waiver; missing reasons fail
 
 The lint pass is pure AST — no jax, no devices, milliseconds.  The audit
 pass lowers the train/eval step of every config in the audit matrix
@@ -13,7 +18,10 @@ accelerator, so both run in CPU-only CI.  The audit pins JAX to CPU with
 8 forced host devices — the manifest is only meaningful under that
 topology (same pin as tests/conftest.py).
 
-Exit status: 0 clean, 1 findings/violations, 2 usage error.
+Exit status: 0 clean, 1 findings/violations (lint, audit, waivers),
+2 usage error, 3 thread-discipline violation (finding or threads.json
+drift — the same hard-gate contract as the budget audit, on its own
+code so preflight can name the failing gate).
 """
 
 import argparse
@@ -37,6 +45,28 @@ def _pin_cpu_topology():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def list_waivers(paths):
+    """Every ``# roclint: allow(...)`` in the tree as
+    ``(path, line, rules, reason)``.  The reason is whatever prose
+    follows the closing paren on the same line — a waiver without one is
+    unauditable and fails the inventory."""
+    from roc_tpu.analysis.lint import _WAIVER_RE
+    from roc_tpu.analysis.threads import _iter_py
+    out = []
+    for path in _iter_py(paths):
+        with open(path, encoding="utf-8") as f:
+            for ln, line in enumerate(f.read().splitlines(), 1):
+                m = _WAIVER_RE.search(line)
+                if not m:
+                    continue
+                if m.start() > 0 and line[m.start() - 1] == "`":
+                    continue   # doc mention (``# roclint: allow(...)``)
+                rules = ",".join(r.strip() for r in m.group(1).split(","))
+                reason = line[m.end():].strip().lstrip("—-: ").strip()
+                out.append((path, ln, rules, reason))
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="roclint", description=__doc__)
     ap.add_argument("paths", nargs="*", help="files/dirs to lint "
@@ -47,6 +77,16 @@ def main(argv=None) -> int:
     ap.add_argument("--update-budgets", action="store_true",
                     help="regenerate roc_tpu/analysis/budgets.json from "
                     "the current tree")
+    ap.add_argument("--threads", action="store_true",
+                    help="lock-discipline analysis, exact-diffed against "
+                    "roc_tpu/analysis/threads.json (exit 3 on violation)")
+    ap.add_argument("--update-threads", action="store_true",
+                    help="regenerate roc_tpu/analysis/threads.json from "
+                    "the current tree")
+    ap.add_argument("--list-waivers", action="store_true",
+                    help="machine-readable inventory of every "
+                    "`# roclint: allow(...)` waiver; exit 1 if any is "
+                    "missing a reason")
     ap.add_argument("--no-lint", action="store_true",
                     help="skip the AST lint pass")
     args = ap.parse_args(argv)
@@ -56,8 +96,9 @@ def main(argv=None) -> int:
     sys.path.insert(0, repo)
 
     rc = 0
-    do_lint = not args.no_lint and (
-        bool(args.paths) or not (args.audit or args.update_budgets))
+    alt_mode = (args.audit or args.update_budgets or args.threads
+                or args.update_threads or args.list_waivers)
+    do_lint = not args.no_lint and (bool(args.paths) or not alt_mode)
     if do_lint:
         from roc_tpu.analysis import lint, mosaic
         paths = args.paths or DEFAULT_PATHS
@@ -90,6 +131,40 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             if viol:
                 rc = 1
+
+    if args.threads or args.update_threads:
+        from roc_tpu.analysis import threads as _threads
+        rep = _threads.analyze_paths(args.paths or ("roc_tpu",))
+        if args.update_threads:
+            _threads.save_baseline(rep)
+            print(f"# roclint: wrote {_threads.BASELINE_PATH} "
+                  f"({len(rep.edges)} edge(s), {len(rep.guarded_by)} "
+                  f"guarded-by fact(s))", file=sys.stderr)
+        else:
+            for f in rep.findings:
+                print(f)
+            drift = _threads.diff_baseline(rep)
+            for line in drift:
+                print(f"THREADS VIOLATION: {line}")
+            print(f"# roclint threads: {len(rep.findings)} finding(s), "
+                  f"{len(drift)} drift line(s), {rep.waived} waived",
+                  file=sys.stderr)
+            if rep.findings or drift:
+                rc = 3
+
+    if args.list_waivers:
+        rows = list_waivers(args.paths or DEFAULT_PATHS)
+        missing = 0
+        for path, ln, rules, reason in rows:
+            if not reason:
+                missing += 1
+                print(f"{path}:{ln}\t{rules}\tMISSING REASON")
+            else:
+                print(f"{path}:{ln}\t{rules}\t{reason}")
+        print(f"# roclint waivers: {len(rows)} waiver(s), "
+              f"{missing} missing reason(s)", file=sys.stderr)
+        if missing:
+            rc = max(rc, 1)
     return rc
 
 

@@ -116,7 +116,7 @@ def run_bench(dataset: str, n_requests: int, qps: float,
             "platform": jax.default_backend(),
             # artifact timestamp, not a measurement record (the ledger
             # pairing lives in the engine); mirrors bench.py's waiver
-            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),  # roclint: allow(unledgered-prediction)
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),  # roclint: allow(unledgered-prediction) — artifact timestamp, not a measurement record
         }
     payload["delta"] = _bench_deltas(cfg, ds, model, ckpt)
     if fleet >= 2:
@@ -156,9 +156,9 @@ def _bench_deltas(cfg, ds, model, ckpt: str) -> dict:
                                         eng.deltas._dst[k]]])
                 # apply latency is the artifact being measured; spans
                 # cannot time it (percentiles need the raw samples)
-                t0 = time.perf_counter()  # roclint: allow(raw-timing)
+                t0 = time.perf_counter()  # roclint: allow(raw-timing) — apply-latency percentiles need the raw samples; spans cannot
                 eng.apply_delta(adds, rets, wait_replan=True)
-                times.append(time.perf_counter() - t0)  # roclint: allow(raw-timing)
+                times.append(time.perf_counter() - t0)  # roclint: allow(raw-timing) — apply-latency percentiles need the raw samples; spans cannot
         st = eng.delta_stats()
     lat = sorted(times)
     return {
@@ -210,12 +210,12 @@ def _bench_fleet(cfg, ds, model, ckpt: str, n_replicas: int,
             rep.engine.warmup()
         # open-loop offer schedule (same anchor discipline as
         # serve/loadgen.run_load; raw clock for the same reason)
-        t0 = time.perf_counter()  # roclint: allow(raw-timing)
+        t0 = time.perf_counter()  # roclint: allow(raw-timing) — open-loop offer schedule anchor, same discipline as loadgen
         with warnings.catch_warnings():
             warnings.simplefilter("ignore", RuntimeWarning)
             for i in range(n_requests):
                 target = t0 + i / qps
-                delay = target - time.perf_counter()  # roclint: allow(raw-timing)
+                delay = target - time.perf_counter()  # roclint: allow(raw-timing) — open-loop offer schedule anchor, same discipline as loadgen
                 if delay > 0:
                     time.sleep(delay)
                 if i % 10 == 5:   # delta churn rides the query stream
@@ -231,7 +231,7 @@ def _bench_fleet(cfg, ds, model, ckpt: str, n_replicas: int,
                     shed += 1   # typed backpressure is an output here
         for f in futures:
             f.result(120.0)
-        wall = time.perf_counter() - t0  # roclint: allow(raw-timing)
+        wall = time.perf_counter() - t0  # roclint: allow(raw-timing) — open-loop offer schedule anchor, same discipline as loadgen
         lats = sorted(f.latency_s for f in futures)
         lags.sort()
         st = router.stats()

@@ -90,7 +90,7 @@ def run_one(sb, ch, slot, rb, ch2, grt, flat=0):
     t0 = time.time()
     if flat:
         # the forced-A/B harness constructs each grid point on purpose:
-        # roclint: allow(hand-rolled-geometry)
+        # roclint: allow(hand-rolled-geometry) — the forced-A/B harness constructs each grid point on purpose
         geom = B.Geometry(sb=sb, ch=ch, slot=slot, rb=rb, ch2=ch2,
                           grt=grt, flat=1)
         plan = B.build_binned_plan(src, dst, N, N, geom=geom,
