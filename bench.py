@@ -199,6 +199,15 @@ if os.environ.get("ROC_MEGAFUSE") == "1":
     _FDEPTH = os.environ.get("ROC_FUSION_DEPTH", "1")
     if _FDEPTH != "1":
         FUSION = f"xlayer-{int(_FDEPTH)}"
+    # Fused GAT attention (round 19): -megafuse on an attention model also
+    # engages the per-head score->softmax->aggregate megakernel, so the leg
+    # is stamped "gat" — a different trace again from "mega"/"xlayer" (the
+    # edge softmax rides inside the binned grid).  ROC_NO_GATFUSE declines
+    # back to the plain mega stamp.  gat legs inherit the mega artifact
+    # policy: metric annotated, excluded from vs_baseline and the canonical
+    # persist until hw_revalidate step 4e's A/B confirms on a device.
+    if MODEL == "gat" and not os.environ.get("ROC_NO_GATFUSE"):
+        FUSION = "gat"
 # The canonical metric (the one vs_baseline and BENCH_LAST_HW speak to) is
 # the unmodified Reddit shape; shape overrides annotate the metric name so
 # histories are never conflated.
@@ -604,6 +613,13 @@ def run():
                 from roc_tpu.memory.estimator import mega_bwd_cotangent_drop
                 mem["mega_bwd_cotangent_drop_bytes"] = \
                     mega_bwd_cotangent_drop(trainer.model, est.rows)
+            elif FUSION == "gat":
+                # predicted residual HBM the fused GAT forward never
+                # materializes (edge-width alpha + qpos planes, net of the
+                # node-width m/z planes the kernel keeps for its backward)
+                from roc_tpu.memory.estimator import gat_residual_drop
+                mem["gat_residual_drop_bytes"] = \
+                    gat_residual_drop(trainer.model, est.rows, est.edges)
             elif FUSION.startswith("xlayer-"):
                 # cross-layer legs: the region planner's predicted
                 # train-step HBM claim, stamped so hw_revalidate step 4d

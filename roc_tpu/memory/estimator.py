@@ -215,6 +215,24 @@ def mega_bwd_cotangent_drop(model, rows: int, itemsize: int = 4) -> int:
     return total
 
 
+def gat_residual_drop(model, rows: int, edges: int,
+                      itemsize: int = 4) -> int:
+    """Predicted residual HBM bytes the fused GAT attention kernel
+    (round 19, ops/pallas/gat.py) eliminates: per gat layer the unfused
+    oracle's VJP saves per-EDGE softmax residuals — the normalized
+    exponentials ``e [E,K]`` fp32 and the leaky-relu sign ``qpos [E,K]``
+    bool — while the fused path keeps per-NODE max/normalizer planes
+    (2 × [rows, K] fp32) instead, pricing the edge-width alpha/gather
+    intermediates at 0.  Reported in bench.py's mem artifact block on
+    fused-attention legs, next to ``mega_bwd_cotangent_drop``."""
+    from roc_tpu.models.model import gat_matches
+    total = 0
+    for rec in gat_matches(model).values():
+        k = rec["heads"]
+        total += edges * k * (itemsize + 1) - 2 * rows * k * 4
+    return max(total, 0)
+
+
 def fixed_bytes_for(model, rows: int, in_dim: int, num_classes: int,
                     edges: int, itemsize: int = 4) -> int:
     """Plan-independent per-device residents: replicated params + Adam

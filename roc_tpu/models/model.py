@@ -191,6 +191,31 @@ def mega_matches(model: "Model") -> Dict[int, dict]:
     return found
 
 
+def gat_matches(model: "Model") -> Dict[int, dict]:
+    """``gat`` ops by op index — the round-19 fused-attention accounting
+    map (ops/pallas/gat.py).
+
+    Deliberately SEPARATE from ``mega_matches``: those records feed
+    ``fuse_linear`` dispatch and ``mega_bwd_cotangent_drop``, and each
+    carries an ``aggregate``+``linear`` pair — a gat record has neither,
+    so joining the same dict would crash every consumer.  The attention
+    megakernel also declines to chain into the trailing concat→linear:
+    the fused grid emits the gat output as head-stacked lane planes
+    ``[rows, heads·head_dim]`` while the next layer's linear consumes
+    row-major feature tiles, so an in-VMEM hand-off would need a
+    cross-lane transpose pass costing more than the HBM round trip it
+    saves.  Fusion dispatch happens inside the ``gat_attend_binned``
+    custom_vjp instead (trace-time decline ladder, ops/edge.py); this map
+    only drives the memory estimator's residual pricing.
+    """
+    found: Dict[int, dict] = {}
+    for i, op in enumerate(model.ops):
+        if op.kind == "gat":
+            found[i] = {"gat": op, "heads": int(op.attrs["heads"]),
+                        "head_dim": int(op.attrs["head_dim"])}
+    return found
+
+
 def mega_regions(model: "Model", max_depth: int = 0,
                  train: bool = False) -> Dict[int, dict]:
     """Chain ``mega_matches`` records into multi-layer fusion regions

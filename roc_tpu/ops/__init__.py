@@ -4,7 +4,8 @@ from roc_tpu.ops.aggregate import (
     region_linear_binned, scatter_gather, scatter_gather_binned,
     scatter_gather_linear_binned, scatter_gather_matmul)
 from roc_tpu.ops.edge import (GatPlans, build_gat_plans, edge_softmax,
-                              gat_attend, gat_attend_plan, pad_gat_plans)
+                              gat_attend, gat_attend_binned,
+                              gat_attend_plan, pad_gat_plans)
 from roc_tpu.ops.norm import indegree_norm
 from roc_tpu.ops.linear import linear
 from roc_tpu.ops.activation import apply_activation, elu, relu, sigmoid
@@ -20,8 +21,8 @@ __all__ = [
     "region_linear_binned",
     "BinnedPlans", "build_binned_plans",
     "pad_binned_plans", "matmul_precision", "divide_by_degree",
-    "edge_softmax", "gat_attend", "gat_attend_plan", "GatPlans",
-    "build_gat_plans", "pad_gat_plans",
+    "edge_softmax", "gat_attend", "gat_attend_binned", "gat_attend_plan",
+    "GatPlans", "build_gat_plans", "pad_gat_plans",
     "indegree_norm", "linear", "relu", "sigmoid", "elu",
     "apply_activation", "add",
     "mul", "dropout", "PerfMetrics", "masked_softmax_cross_entropy",

@@ -1,0 +1,931 @@
+"""Fused GAT attention megakernel — per-head score -> edge-softmax ->
+weighted aggregate riding the binned schedule (round 19).
+
+The plan backend's attention composition (``ops/edge.py``,
+``gat_attend_plan``) round-trips the widest tensors in the tree through
+HBM: the ``[E, K]`` score/alpha planes (three times each: max pass,
+normalizer sum, weighted sum) and a gathered ``[E, K, F]`` feature chunk
+for the weighted aggregate.  This module re-runs that composition as
+Pallas grids over the SAME binned schedule the megakernel family uses
+(``_attach_fused``): phase 1 gathers source rows block-locally and DMAs
+them into the VMEM staging buffer, phase 2 consumes staging chunks
+against a VMEM-resident per-bin window.  Alpha and the gathered features
+exist only as ``[CH, ·]`` register tiles between two MXU dots — they
+never touch HBM.
+
+Layout: heads are stacked on the lane (block) axis, exactly like fusion
+depth in ``_xlayer_run`` — features enter flattened ``[rows, K*F]``
+padded to ``Hp = pad128(K*F)``, and the per-head score/normalizer/max
+quantities live in 128-lane "alpha planes" (lane k = head k).  Constant
+matrices built from 2-D iotas move between the two layouts on the MXU:
+
+* ``A  [Hp, 128]``  — ``A[k*F+f, k] = a_src[k, f]``: one dot against a
+  staged feature chunk computes the per-edge source score contribution
+  ``as_t[src_e, k]`` in-kernel, so no separate score band is staged.
+* ``M  [128, Hp]``  — head-expand: ``e_wide = e @ M`` broadcasts the
+  per-head alpha across that head's F lanes.
+* ``MT [Hp, 128]``  — per-head lane-range reduce: ``(du*x) @ MT`` is the
+  backward's per-head feature contraction.
+
+Softmax stability contract: two passes over the identical schedule (the
+ISSUE's max+sum structure).  The max pass folds a segment-max of the
+leaky-relu scores into the per-bin ``m`` plane (init -1e30; rows with no
+in-edges keep it — only real edges' rows are read downstream, matching
+the oracle's ``isfinite`` guard).  The sum pass re-stages the same bytes,
+recomputes the identical score (same dots on same inputs => bitwise the
+same), forms ``e = exp(s - m[dst]) <= 1`` (no overflow by construction),
+and accumulates the normalizer ``z`` (always fp32-``highest`` — the
+oracle's contract: only the two ``[*, K, F]`` feature sums take the
+user precision) and the weighted aggregate ``u``; the bin's last real
+chunk divides in place (pad-step revisits add exact zeros, which commute
+with the divide).  Phase-1 gathers always use the EXACT one-hot dot
+(3-way bf16 split): staged features feed ``exp``, where a bf16 rounding
+would blow the parity budget.
+
+Backward: two transposed-plan grids, mirroring the oracle VJP's own
+dst-plan/src-plan split — no gather transposes into a scatter:
+
+* grid S rides ``plans.bwd`` (the transposed plan): stages the dst-keyed
+  ``[du | dz | ad_l | m]`` band (pack lanes ``[0:K) dz, [K:2K) ad,
+  [2K:3K) m`` — admission requires ``3K <= 128``), recomputes ``e`` from
+  the window-resident table rows, and reduces ``dtable`` (+``dast``)
+  onto source-row windows.
+* grid D rides ``plans.fwd``: stages table rows (the forward's own
+  operand), gathers the dst-keyed band from a ``[RB, Hp+384]`` window,
+  and reduces ``dadl`` onto destination-row windows.
+
+Decline ladder (each rung falls back to the unfused composition, which
+is bitwise the oracle): non-flat geometry or no fused schedule attached
+(``f_meta``) -> unfused; bf16 staging (``unit == 16``) -> unfused (the
+score path needs fp32 staging); ``K > 32`` or ``3K > 128`` after
+head-group splitting -> unfused; forward VMEM admission fails at every
+head-group split -> unfused; backward admission fails (either grid) ->
+fused forward with oracle-recompute backward.  ``ROC_NO_GATFUSE=1``
+kills the whole family; ``ROC_GAT_BWD=0`` kills only the backward grids.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from roc_tpu.ops.pallas.binned import (                          # noqa: F401
+    _DMA_CLS,
+    _VMEM_BUDGET,
+    BinnedPlan,
+    Geometry,
+    _onehot_dot,
+    _pad_to,
+)
+
+_NEG = -1e30          # max-pass identity; matches the oracle's -inf guard
+_Z_GUARD = 1e-15      # keep in sync with ops/edge.py
+
+
+# --------------------------------------------------------------------------
+# Kill switches (warn-once, dispatch-site checked — the megafuse pattern)
+# --------------------------------------------------------------------------
+
+_GAT_KILL_WARNED = [False]
+_GAT_BWD_KILL_WARNED = [False]
+
+
+def gat_fuse_killed() -> bool:
+    """True when ROC_NO_GATFUSE=1 disables fused GAT attention at
+    runtime (checked at every dispatch site; warn-once)."""
+    if not os.environ.get("ROC_NO_GATFUSE"):
+        return False
+    if not _GAT_KILL_WARNED[0]:
+        _GAT_KILL_WARNED[0] = True
+        warnings.warn(
+            "ROC_NO_GATFUSE=1: fused GAT attention disabled; eligible "
+            "layers run the unfused plan composition instead.",
+            stacklevel=2)
+    return True
+
+
+def gat_bwd_killed() -> bool:
+    """True when ROC_GAT_BWD=0 disables only the fused GAT backward
+    grids (forward fusion unaffected; warn-once)."""
+    if os.environ.get("ROC_GAT_BWD", "") != "0":
+        return False
+    if not _GAT_BWD_KILL_WARNED[0]:
+        _GAT_BWD_KILL_WARNED[0] = True
+        warnings.warn(
+            "ROC_GAT_BWD=0: fused GAT backward disabled; gradients "
+            "recompute the oracle VJP from the saved max plane instead.",
+            stacklevel=2)
+    return True
+
+
+# --------------------------------------------------------------------------
+# VMEM admission
+# --------------------------------------------------------------------------
+
+def _gat_vmem_ok(geom: Geometry, Hp: int, c2: int,
+                 groups: int = 2) -> bool:
+    """Trace-time admission for the forward passes (the sum pass is the
+    wider of the two).  Charges the named residents only — the mega
+    budget's philosophy; register-tile temporaries live in the 2 MB
+    slack above _VMEM_BUDGET.  Staging is always fp32 here (the score
+    path declines bf16 staging), so no staging_dtype dance."""
+    nparity = 1 if groups == 1 else 2
+    srows = c2 * geom.ch2
+    need = (nparity * srows * Hp * 4          # staging (fp32, exact gather)
+            + geom.ch * Hp * 4                # gbuf
+            + max(geom.ch * geom.sb, geom.ch2 * geom.rb) * 2   # one-hot tile
+            + 2 * geom.sb * Hp * 4            # dual x blocks
+            + Hp * 128 * 4                    # A (source-score matrix)
+            + 3 * geom.rb * 128 * 4           # ad + m windows, z out
+            + geom.rb * Hp * 4)               # u out window
+    return need <= _VMEM_BUDGET
+
+
+def _gat_bwd_vmem_ok(geom_d: Geometry, geom_s: Geometry, Hp: int,
+                     c2_d: int, c2_s: int, groups_d: int = 2,
+                     groups_s: int = 2) -> bool:
+    """Admission for BOTH backward grids.  Grid D (dst plan) stages at
+    width Hp but holds the [RB, Hp+384] cotangent-band window; grid S
+    (src plan) stages at width Hp+128 (du plus the packed dz/ad/m band)
+    and holds dual out windows."""
+    np_d = 1 if groups_d == 1 else 2
+    np_s = 1 if groups_s == 1 else 2
+    wd = Hp + 3 * 128
+    ws = Hp + 128
+    need_d = (np_d * c2_d * geom_d.ch2 * Hp * 4
+              + geom_d.ch * Hp * 4
+              + max(geom_d.ch * geom_d.sb, geom_d.ch2 * geom_d.rb) * 2
+              + 2 * geom_d.sb * Hp * 4
+              + Hp * 128 * 4
+              + geom_d.rb * wd * 4            # ducat window
+              + geom_d.rb * 128 * 4)          # dadl out
+    need_s = (np_s * c2_s * geom_s.ch2 * ws * 4
+              + geom_s.ch * ws * 4
+              + max(geom_s.ch * geom_s.sb, geom_s.ch2 * geom_s.rb) * 2
+              + 2 * geom_s.sb * ws * 4
+              + Hp * 128 * 4
+              + geom_s.rb * Hp * 4            # table window
+              + geom_s.rb * ws * 4)           # dtable + dast outs
+    return need_d <= _VMEM_BUDGET and need_s <= _VMEM_BUDGET
+
+
+def _plan_fused(plan) -> bool:
+    return (plan is not None and plan.geom.flat
+            and plan.f_meta is not None and plan.f_last is not None
+            and plan.geom.unit != 16)
+
+
+def gat_head_groups(plans_fwd: BinnedPlan, plans_bwd: BinnedPlan,
+                    heads: int, head_dim: int):
+    """Static eligibility: returns (head_groups, bwd_ok) or (0, False)
+    when the forward cannot be admitted at any head split.  Heads are
+    independent in GAT (each group is the oracle restricted to its
+    heads), so splitting K into ng groups shrinks the stacked width
+    Hp = pad128((K/ng)*F) until the VMEM gates pass — the lattice's
+    head-stacking axis (`ghg`) can pin a specific split."""
+    if not _plan_fused(plans_fwd):
+        return 0, False
+    geom = plans_fwd.geom
+    c2 = int(plans_fwd.p2_obi.shape[1])
+    g = int(plans_fwd.p1_blk.shape[0])
+    forced = int(os.environ.get("ROC_GAT_HEADGROUPS", "0") or 0)
+    for ng in range(1, heads + 1):
+        if heads % ng:
+            continue
+        if forced and ng != forced:
+            continue
+        kg = heads // ng
+        if kg > 32 or 3 * kg > 128:
+            continue
+        hp = _pad_to(kg * head_dim, 128)
+        if not _gat_vmem_ok(geom, hp, c2, groups=g):
+            continue
+        bwd_ok = False
+        if _plan_fused(plans_bwd):
+            bwd_ok = _gat_bwd_vmem_ok(
+                geom, plans_bwd.geom, hp,
+                c2, int(plans_bwd.p2_obi.shape[1]),
+                groups_d=g, groups_s=int(plans_bwd.p1_blk.shape[0]))
+        return ng, bwd_ok
+    return 0, False
+
+
+# --------------------------------------------------------------------------
+# In-kernel constant matrices (2-D iotas — Mosaic folds them)
+# --------------------------------------------------------------------------
+
+def _expand_mat(K: int, F: int, Hp: int):
+    """[128, Hp] head-expand: (e @ M)[c, k*F+f] = e[c, k]."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (128, Hp), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (128, Hp), 1)
+    return ((c // F == r) & (c < K * F)).astype(jnp.float32)
+
+
+def _reduce_mat(K: int, F: int, Hp: int):
+    """[Hp, 128] per-head reduce: (p @ MT)[c, k] = sum_f p[c, k*F+f]."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (Hp, 128), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (Hp, 128), 1)
+    return ((r // F == c) & (r < K * F)).astype(jnp.float32)
+
+
+def _sel_mat(off: int, K: int):
+    """[128, 128] band-select: (pack @ S)[c, k] = pack[c, k+off], k<K."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+    return ((r == c + off) & (c < K)).astype(jnp.float32)
+
+
+def _hdot(a, b, dims=(((1,), (0,)), ((), ()))):
+    return jax.lax.dot_general(a, b, dims,
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Shared phase-1 body (the megakernel's gather + DMA schedule, with the
+# one difference that the one-hot gather is ALWAYS exact: staged bytes
+# feed exp(), so they must be the fp32 features bit-for-bit)
+# --------------------------------------------------------------------------
+
+def _stage_chunk(c, blk_ref, blk2_ref, dsrc_ref, ddst_ref, rows_ref,
+                 x_ref, x2_ref, gbuf, stgbuf, sems, par, geom):
+    CH, SB, KD = geom.ch, geom.sb, geom.kd
+    U = geom.unit_rows
+    lane = jax.lax.broadcasted_iota(jnp.int32, (CH, SB), 1)
+    sl = rows_ref[:]
+    t1 = (lane == sl).astype(jnp.bfloat16)
+    gbuf[:] = _onehot_dot(t1, x_ref[:], (((1,), (0,)), ((), ())),
+                          True).astype(jnp.float32)
+
+    @pl.when(blk2_ref[c] != blk_ref[c])
+    def _():
+        t2 = (lane == sl - SB).astype(jnp.bfloat16)
+        gbuf[:] = gbuf[:] + _onehot_dot(
+            t2, x2_ref[:], (((1,), (0,)), ((), ())), True)
+
+    def issue(e, _):
+        v = dsrc_ref[c % 8, e]
+
+        @pl.when(v >= 0)
+        def _():
+            cls = v // 65536
+            su = v - cls * 65536
+            du = ddst_ref[c % 8, e]
+            for ci, csz in enumerate(_DMA_CLS):
+                @pl.when(cls == ci)
+                def _(csz=csz):
+                    pltpu.make_async_copy(
+                        gbuf.at[pl.ds(su * U, csz * U)],
+                        stgbuf.at[par].at[pl.ds(du * U, csz * U)],
+                        sems.at[0]).start()
+        return 0
+    jax.lax.fori_loop(0, KD, issue, 0)
+
+    def drain(e, _):
+        v = dsrc_ref[c % 8, e]
+
+        @pl.when(v >= 0)
+        def _():
+            cls = v // 65536
+            su = v - cls * 65536
+            du = ddst_ref[c % 8, e]
+            for ci, csz in enumerate(_DMA_CLS):
+                @pl.when(cls == ci)
+                def _(csz=csz):
+                    pltpu.make_async_copy(
+                        gbuf.at[pl.ds(su * U, csz * U)],
+                        stgbuf.at[par].at[pl.ds(du * U, csz * U)],
+                        sems.at[0]).wait()
+        return 0
+    jax.lax.fori_loop(0, KD, drain, 0)
+
+
+def _chunk_score(dl, gv, s_t32, a_ref, ad_win, slope, geom):
+    """Per-slot leaky-relu score for one staging chunk: the source
+    contribution comes from a dot against A on the staged (exact fp32)
+    features, the destination contribution is gathered from the
+    window-resident ad plane.  Pad slots (dl == RB, gv zeroed) score 0 —
+    inert: the max pass masks them and the sum passes' one-hot out dots
+    carry zero rows for them."""
+    as_c = _hdot(gv, a_ref[:])                       # [CH, 128]
+    ad_c = _hdot(s_t32, ad_win, (((1,), (0,)), ((), ())))
+    q = ad_c + as_c
+    s = jnp.where(q >= 0, q, q * slope)
+    return q, s
+
+
+# --------------------------------------------------------------------------
+# Forward pass 1: per-bin per-head segment max of the scores
+# --------------------------------------------------------------------------
+
+def _gat_max_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref,
+                    ddst_ref, rows_ref, x_ref, x2_ref, a_ref, ad_ref,
+                    m_ref, gbuf, stgbuf, sems, *, geom: Geometry = None,
+                    K: int = 1, F: int = 1, slope: float = 0.2):
+    """Kind 0 stages source features (exact).  Kind 1 folds the chunk's
+    scores into the resident per-bin max plane m [RB, 128] via a K-
+    unrolled segment max: extract head k's score column, mask it onto
+    the one-hot slot->row pattern, reduce over slots, and transpose the
+    [1, RB] row back to a [RB, 1] column on the diagonal mask (no
+    lane<->sublane transpose op needed).  Rows with no in-edges keep
+    -1e30 — never read downstream (the oracle's isfinite guard)."""
+    CH, RB = geom.ch, geom.rb
+    c = pl.program_id(0)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        _stage_chunk(c, blk_ref, blk2_ref, dsrc_ref, ddst_ref, rows_ref,
+                     x_ref, x2_ref, gbuf, stgbuf, sems, par, geom)
+
+    @pl.when(kind == 1)
+    def _():
+        @pl.when(first == 1)
+        def _():
+            m_ref[:] = jnp.full_like(m_ref, _NEG)
+
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        gv = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        stb = lane == dl
+        s_t32 = stb.astype(jnp.float32)
+        _, s = _chunk_score(dl, gv, s_t32, a_ref, ad_ref[:], slope, geom)
+
+        lk = jax.lax.broadcasted_iota(jnp.int32, (CH, 128), 1)
+        r_rb = jax.lax.broadcasted_iota(jnp.int32, (RB, RB), 0)
+        c_rb = jax.lax.broadcasted_iota(jnp.int32, (RB, RB), 1)
+        l128 = jax.lax.broadcasted_iota(jnp.int32, (RB, 128), 1)
+        acc = jnp.full((RB, 128), _NEG, jnp.float32)
+        for k in range(K):
+            sk = jnp.sum(jnp.where(lk == k, s, 0.0), axis=1,
+                         keepdims=True)                      # [CH, 1]
+            mk = jnp.max(jnp.where(stb, sk, _NEG), axis=0,
+                         keepdims=True)                      # [1, RB]
+            col = jnp.max(
+                jnp.where(c_rb == r_rb, jnp.broadcast_to(mk, (RB, RB)),
+                          _NEG), axis=1, keepdims=True)      # [RB, 1]
+            acc = jnp.maximum(
+                acc, jnp.where(l128 == k, jnp.broadcast_to(col, (RB, 128)),
+                               _NEG))
+        m_ref[:] = jnp.maximum(m_ref[:], acc)
+
+
+@partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
+                                   "geom", "K", "F", "slope", "nparity"))
+def _gat_max_run(x, a, ad, blk, blk2, obi, meta, dsrc, ddst, rows,
+                 nsteps: int, c2: int, out_rows: int,
+                 interpret: bool = False, geom: Geometry = None,
+                 K: int = 1, F: int = 1, slope: float = 0.2,
+                 nparity: int = 2):
+    Hp = x.shape[-1]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    srows = c2 * geom.ch2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,                  # blk, blk2, obi [S]
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((8, 4), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, b, b2, o: (c, 0)),
+            pl.BlockSpec((SB, Hp), lambda c, b, b2, o: (b[c], 0)),
+            pl.BlockSpec((SB, Hp), lambda c, b, b2, o: (b2[c], 0)),
+            # source-score matrix A, constant index: VMEM-resident
+            pl.BlockSpec((Hp, 128), lambda c, b, b2, o: (0, 0)),
+            pl.BlockSpec((RB, 128), lambda c, b, b2, o: (o[c], 0)),
+        ],
+        out_specs=pl.BlockSpec((RB, 128), lambda c, b, b2, o: (o[c], 0)),
+        scratch_shapes=[pltpu.VMEM((CH, Hp), jnp.float32),
+                        pltpu.VMEM((nparity, srows, Hp), jnp.float32),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    return pl.pallas_call(
+        partial(_gat_max_kernel, geom=geom, K=K, F=F, slope=slope),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_rows, 128), jnp.float32),
+        interpret=interpret,
+    )(blk, blk2, obi, meta, dsrc, ddst, rows, x, x, a, ad)
+
+
+# --------------------------------------------------------------------------
+# Forward pass 2: normalizer + weighted aggregate (+ in-place divide)
+# --------------------------------------------------------------------------
+
+def _gat_sum_kernel(blk_ref, blk2_ref, obi_ref, last_ref, meta_ref,
+                    dsrc_ref, ddst_ref, rows_ref, x_ref, x2_ref, a_ref,
+                    ad_ref, m_ref, u_ref, z_ref, gbuf, stgbuf, sems, *,
+                    exact: bool = False, geom: Geometry = None,
+                    K: int = 1, F: int = 1, slope: float = 0.2):
+    """Kind 0 re-stages the same bytes as the max pass (same schedule,
+    same exact gather => bitwise the same features, hence bitwise the
+    same recomputed score).  Kind 1 forms e = exp(s - m[dst]) <= 1,
+    accumulates z += onehot^T e (always highest — the oracle's
+    normalizer contract) and u += onehot^T (head_expand(e) * features)
+    (the [*, K, F] feature sum — follows `precision` via _onehot_dot's
+    exact flag), then divides the bin's u by max(z, guard) on its LAST
+    real chunk.  Pad slots carry e = exp(0) = 1 but ride all-zero
+    one-hot rows, so their contribution is an exact fp32 zero; pad-step
+    revisits after the divide add exact zeros, which commute with it."""
+    CH, RB = geom.ch, geom.rb
+    c = pl.program_id(0)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        _stage_chunk(c, blk_ref, blk2_ref, dsrc_ref, ddst_ref, rows_ref,
+                     x_ref, x2_ref, gbuf, stgbuf, sems, par, geom)
+
+    @pl.when(kind == 1)
+    def _():
+        @pl.when(first == 1)
+        def _():
+            u_ref[:] = jnp.zeros_like(u_ref)
+            z_ref[:] = jnp.zeros_like(z_ref)
+
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        gv = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        stb = lane == dl
+        s_t = stb.astype(jnp.bfloat16)
+        s_t32 = stb.astype(jnp.float32)
+        _, s = _chunk_score(dl, gv, s_t32, a_ref, ad_ref[:], slope, geom)
+        m_c = _hdot(s_t32, m_ref[:], (((1,), (0,)), ((), ())))
+        # mask dead head lanes BEFORE the expand dot: their m stays at
+        # -1e30, so exp would overflow to inf and inf*0 => NaN in the dot
+        lk = jax.lax.broadcasted_iota(jnp.int32, (CH, 128), 1)
+        e = jnp.where(lk < K, jnp.exp(s - m_c), 0.0)
+        ew = _hdot(e, _expand_mat(K, F, gv.shape[-1]))      # [CH, Hp]
+        u_ref[:] += _onehot_dot(s_t, ew * gv, (((0,), (0,)), ((), ())),
+                                exact)
+        z_ref[:] += _hdot(s_t32, e, (((0,), (0,)), ((), ())))
+
+        @pl.when(last_ref[c] == 1)
+        def _():
+            zw = _hdot(z_ref[:], _expand_mat(K, F, gv.shape[-1]))
+            u_ref[:] = u_ref[:] / jnp.maximum(zw, _Z_GUARD)
+
+
+@partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
+                                   "exact", "geom", "K", "F", "slope",
+                                   "nparity"))
+def _gat_sum_run(x, a, ad, m, blk, blk2, obi, last, meta, dsrc, ddst,
+                 rows, nsteps: int, c2: int, out_rows: int,
+                 interpret: bool = False, exact: bool = False,
+                 geom: Geometry = None, K: int = 1, F: int = 1,
+                 slope: float = 0.2, nparity: int = 2):
+    Hp = x.shape[-1]
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    srows = c2 * geom.ch2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,                  # blk, blk2, obi, last [S]
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((8, 4), lambda c, b, b2, o, l: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o, l: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o, l: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, b, b2, o, l: (c, 0)),
+            pl.BlockSpec((SB, Hp), lambda c, b, b2, o, l: (b[c], 0)),
+            pl.BlockSpec((SB, Hp), lambda c, b, b2, o, l: (b2[c], 0)),
+            pl.BlockSpec((Hp, 128), lambda c, b, b2, o, l: (0, 0)),
+            pl.BlockSpec((RB, 128), lambda c, b, b2, o, l: (o[c], 0)),
+            pl.BlockSpec((RB, 128), lambda c, b, b2, o, l: (o[c], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((RB, Hp), lambda c, b, b2, o, l: (o[c], 0)),
+            pl.BlockSpec((RB, 128), lambda c, b, b2, o, l: (o[c], 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((CH, Hp), jnp.float32),
+                        pltpu.VMEM((nparity, srows, Hp), jnp.float32),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    return pl.pallas_call(
+        partial(_gat_sum_kernel, exact=exact, geom=geom, K=K, F=F,
+                slope=slope),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((out_rows, Hp), jnp.float32),
+                   jax.ShapeDtypeStruct((out_rows, 128), jnp.float32)],
+        interpret=interpret,
+    )(blk, blk2, obi, last, meta, dsrc, ddst, rows, x, x, a, ad, m)
+
+
+# --------------------------------------------------------------------------
+# Backward grid D (dst plan): dadl — the oracle's dst-plan dq sum
+# --------------------------------------------------------------------------
+
+def _gat_bwd_dst_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref,
+                        ddst_ref, rows_ref, x_ref, x2_ref, a_ref,
+                        dd_ref, dadl_ref, gbuf, stgbuf, sems, *,
+                        geom: Geometry = None, K: int = 1, F: int = 1,
+                        slope: float = 0.2):
+    """Stages table rows (the forward operand); one big MXU dot gathers
+    the whole dst-keyed [du | dz | ad | m] band per slot from the
+    window, then recomputes e and the per-edge dq and reduces it onto
+    the resident dadl plane.  dq[e,k] = e * (sum_f du[dst]*x[src] +
+    dz[dst]) * dlrelu — the oracle's formula, one chunk at a time."""
+    CH, RB = geom.ch, geom.rb
+    Hp = gbuf.shape[-1]
+    c = pl.program_id(0)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        _stage_chunk(c, blk_ref, blk2_ref, dsrc_ref, ddst_ref, rows_ref,
+                     x_ref, x2_ref, gbuf, stgbuf, sems, par, geom)
+
+    @pl.when(kind == 1)
+    def _():
+        @pl.when(first == 1)
+        def _():
+            dadl_ref[:] = jnp.zeros_like(dadl_ref)
+
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        gv = jnp.where(dl == RB, jnp.float32(0), chunk)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        stb = lane == dl
+        s_t32 = stb.astype(jnp.float32)
+        all_c = _hdot(s_t32, dd_ref[:], (((1,), (0,)), ((), ())))
+        du_c = all_c[:, :Hp]
+        dz_c = all_c[:, Hp:Hp + 128]
+        ad_c = all_c[:, Hp + 128:Hp + 256]
+        m_c = all_c[:, Hp + 256:]
+        as_c = _hdot(gv, a_ref[:])
+        q = ad_c + as_c
+        s = jnp.where(q >= 0, q, q * slope)
+        # dead head lanes carry m = -1e30 in the band: mask like the
+        # forward sum pass (exp overflow -> inf*0 NaN in the dots)
+        lk = jax.lax.broadcasted_iota(jnp.int32, (CH, 128), 1)
+        e = jnp.where(lk < K, jnp.exp(s - m_c), 0.0)
+        de = _hdot(du_c * gv, _reduce_mat(K, F, Hp)) + dz_c
+        dq = e * de * jnp.where(q >= 0, 1.0, slope)
+        dadl_ref[:] += _hdot(s_t32, dq, (((0,), (0,)), ((), ())))
+
+
+@partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
+                                   "geom", "K", "F", "slope", "nparity"))
+def _gat_bwd_dst_run(x, a, dd, blk, blk2, obi, meta, dsrc, ddst, rows,
+                     nsteps: int, c2: int, out_rows: int,
+                     interpret: bool = False, geom: Geometry = None,
+                     K: int = 1, F: int = 1, slope: float = 0.2,
+                     nparity: int = 2):
+    Hp = x.shape[-1]
+    Wd = Hp + 3 * 128
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    srows = c2 * geom.ch2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((8, 4), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, b, b2, o: (c, 0)),
+            pl.BlockSpec((SB, Hp), lambda c, b, b2, o: (b[c], 0)),
+            pl.BlockSpec((SB, Hp), lambda c, b, b2, o: (b2[c], 0)),
+            pl.BlockSpec((Hp, 128), lambda c, b, b2, o: (0, 0)),
+            pl.BlockSpec((RB, Wd), lambda c, b, b2, o: (o[c], 0)),
+        ],
+        out_specs=pl.BlockSpec((RB, 128), lambda c, b, b2, o: (o[c], 0)),
+        scratch_shapes=[pltpu.VMEM((CH, Hp), jnp.float32),
+                        pltpu.VMEM((nparity, srows, Hp), jnp.float32),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    return pl.pallas_call(
+        partial(_gat_bwd_dst_kernel, geom=geom, K=K, F=F, slope=slope),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_rows, 128), jnp.float32),
+        interpret=interpret,
+    )(blk, blk2, obi, meta, dsrc, ddst, rows, x, x, a, dd)
+
+
+# --------------------------------------------------------------------------
+# Backward grid S (src / transposed plan): dtable + dast
+# --------------------------------------------------------------------------
+
+def _gat_bwd_src_kernel(blk_ref, blk2_ref, obi_ref, meta_ref, dsrc_ref,
+                        ddst_ref, rows_ref, d_ref, d2_ref, a_ref,
+                        tbl_ref, dtbl_ref, dast_ref, gbuf, stgbuf, sems,
+                        *, exact: bool = False, geom: Geometry = None,
+                        K: int = 1, F: int = 1, slope: float = 0.2):
+    """Transposed-plan grid: stages the dst-keyed [du | pack] band
+    (pack lanes [0:K) dz, [K:2K) ad, [2K:3K) m), gathers the source
+    row's features from the window-resident table (exact fp32 => e
+    recomputes bitwise vs the forward), and reduces both dtable (the
+    oracle's src-plan feature sum — follows `precision`) and dast (the
+    src-plan dq sum — always highest) onto the dual out windows."""
+    CH, RB = geom.ch, geom.rb
+    Hp = tbl_ref.shape[-1]
+    c = pl.program_id(0)
+    kind = meta_ref[c % 8, 0]
+    par = meta_ref[c % 8, 1]
+    first = meta_ref[c % 8, 2]
+    sq = meta_ref[c % 8, 3]
+
+    @pl.when(kind == 0)
+    def _():
+        _stage_chunk(c, blk_ref, blk2_ref, dsrc_ref, ddst_ref, rows_ref,
+                     d_ref, d2_ref, gbuf, stgbuf, sems, par, geom)
+
+    @pl.when(kind == 1)
+    def _():
+        @pl.when(first == 1)
+        def _():
+            dtbl_ref[:] = jnp.zeros_like(dtbl_ref)
+            dast_ref[:] = jnp.zeros_like(dast_ref)
+
+        dl = rows_ref[:]
+        chunk = stgbuf[par, pl.ds(sq * CH, CH)]
+        gv = jnp.where(dl == RB, jnp.float32(0), chunk)
+        duv = gv[:, :Hp]
+        packv = gv[:, Hp:]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (CH, RB), 1)
+        stb = lane == dl
+        s_t = stb.astype(jnp.bfloat16)
+        s_t32 = stb.astype(jnp.float32)
+        tbl_c = _hdot(s_t32, tbl_ref[:], (((1,), (0,)), ((), ())))
+        as_c = _hdot(tbl_c, a_ref[:])
+        dz_c = _hdot(packv, _sel_mat(0, K))
+        ad_c = _hdot(packv, _sel_mat(K, K))
+        m_c = _hdot(packv, _sel_mat(2 * K, K))
+        q = ad_c + as_c
+        s = jnp.where(q >= 0, q, q * slope)
+        e = jnp.exp(s - m_c)
+        de = _hdot(duv * tbl_c, _reduce_mat(K, F, Hp)) + dz_c
+        dq = e * de * jnp.where(q >= 0, 1.0, slope)
+        ew = _hdot(e, _expand_mat(K, F, Hp))
+        dtbl_ref[:] += _onehot_dot(s_t, ew * duv,
+                                   (((0,), (0,)), ((), ())), exact)
+        dast_ref[:] += _hdot(s_t32, dq, (((0,), (0,)), ((), ())))
+
+
+@partial(jax.jit, static_argnames=("nsteps", "c2", "out_rows", "interpret",
+                                   "exact", "geom", "K", "F", "slope",
+                                   "nparity"))
+def _gat_bwd_src_run(dd, a, tbl, blk, blk2, obi, meta, dsrc, ddst, rows,
+                     nsteps: int, c2: int, out_rows: int,
+                     interpret: bool = False, exact: bool = False,
+                     geom: Geometry = None, K: int = 1, F: int = 1,
+                     slope: float = 0.2, nparity: int = 2):
+    Hp = tbl.shape[-1]
+    Ws = dd.shape[-1]                           # Hp + 128
+    CH, SB, RB, KD = geom.ch, geom.sb, geom.rb, geom.kd            # noqa
+    srows = c2 * geom.ch2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((8, 4), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, KD), lambda c, b, b2, o: (c // 8, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((CH, 1), lambda c, b, b2, o: (c, 0)),
+            pl.BlockSpec((SB, Ws), lambda c, b, b2, o: (b[c], 0)),
+            pl.BlockSpec((SB, Ws), lambda c, b, b2, o: (b2[c], 0)),
+            pl.BlockSpec((Hp, 128), lambda c, b, b2, o: (0, 0)),
+            pl.BlockSpec((RB, Hp), lambda c, b, b2, o: (o[c], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((RB, Hp), lambda c, b, b2, o: (o[c], 0)),
+            pl.BlockSpec((RB, 128), lambda c, b, b2, o: (o[c], 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((CH, Ws), jnp.float32),
+                        pltpu.VMEM((nparity, srows, Ws), jnp.float32),
+                        pltpu.SemaphoreType.DMA((1,))],
+    )
+    return pl.pallas_call(
+        partial(_gat_bwd_src_kernel, exact=exact, geom=geom, K=K, F=F,
+                slope=slope),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((out_rows, Hp), jnp.float32),
+                   jax.ShapeDtypeStruct((out_rows, 128), jnp.float32)],
+        interpret=interpret,
+    )(blk, blk2, obi, meta, dsrc, ddst, rows, dd, dd, a, tbl)
+
+
+# --------------------------------------------------------------------------
+# Dispatch (single head group — ops/edge.py loops groups)
+# --------------------------------------------------------------------------
+
+def _score_matrix(a_src, K: int, F: int, Hp: int):
+    af = a_src.astype(jnp.float32).reshape(K * F)
+    idx = np.arange(K * F)
+    return jnp.zeros((Hp, 128), jnp.float32).at[idx, idx // F].set(af)
+
+
+def _plan_dims(plan: BinnedPlan):
+    c2 = int(plan.p2_obi.shape[1])
+    g = int(plan.p1_blk.shape[0])
+    s = int(plan.f_blk.shape[0])
+    out_rows = g * plan.bins_per_group * plan.geom.rb
+    return c2, g, s, out_rows
+
+
+def run_binned_gat(table, a_src, ad_l, plan: BinnedPlan, slope: float,
+                   interpret: bool = False, precision: str = "fast"):
+    """Fused GAT attention forward for ONE head group.
+
+    table [T, K, F] (source features), a_src [K, F], ad_l [N, K] (the
+    destination score contribution, computed by the caller with the
+    oracle's own einsum) -> (out [N, K, F], m [OR, 128], z [OR, 128])
+    where OR is the plan's padded out-row count; m/z are the padded
+    alpha planes handed back as backward residuals.  Caller checks
+    eligibility (gat_head_groups) before calling."""
+    geom = plan.geom
+    T, K, F = table.shape
+    N = plan.num_rows
+    Hp = _pad_to(K * F, 128)
+    exact = precision == "exact"
+    c2, g, s, out_rows = _plan_dims(plan)
+    nparity = 1 if g == 1 else 2
+    tflat = table.astype(jnp.float32).reshape(T, K * F)
+    xp = jnp.pad(tflat, ((0, _pad_to(plan.table_rows, geom.sb) - T),
+                         (0, Hp - K * F)))
+    a = _score_matrix(a_src, K, F, Hp)
+    adp = jnp.pad(ad_l.astype(jnp.float32),
+                  ((0, out_rows - N), (0, 128 - K)))
+    with jax.named_scope("roc_binned_gat"):
+        m = _gat_max_run(xp, a, adp, plan.f_blk, plan.f_blk2, plan.f_obi,
+                         plan.f_meta, plan.f_dsrc, plan.f_ddst,
+                         plan.f_rows, nsteps=s, c2=c2, out_rows=out_rows,
+                         interpret=interpret, geom=geom, K=K, F=F,
+                         slope=float(slope), nparity=nparity)
+        u, z = _gat_sum_run(xp, a, adp, m, plan.f_blk, plan.f_blk2,
+                            plan.f_obi, plan.f_last, plan.f_meta,
+                            plan.f_dsrc, plan.f_ddst, plan.f_rows,
+                            nsteps=s, c2=c2, out_rows=out_rows,
+                            interpret=interpret, exact=exact, geom=geom,
+                            K=K, F=F, slope=float(slope),
+                            nparity=nparity)
+    out = u[:N, :K * F].reshape(N, K, F)
+    return out, m, z
+
+
+def run_binned_gat_bwd(gout, out, table, a_src, ad_l, m, z,
+                       plan_fwd: BinnedPlan, plan_bwd: BinnedPlan,
+                       slope: float, interpret: bool = False,
+                       precision: str = "fast"):
+    """Fused backward for ONE head group: two transposed-plan grids.
+
+    Returns the three aggregate sums (dtable_agg [T, K, F],
+    dast [T, K], dadl [N, K]); the caller composes the oracle's
+    epilogue (rank-1 a_src/a_dst terms and the dh/da_* einsums) in XLA.
+    No gather transposes into a scatter: grid S reduces src-keyed sums
+    over plans.bwd, grid D reduces the dst-keyed sum over plans.fwd."""
+    geom_d, geom_s = plan_fwd.geom, plan_bwd.geom
+    T, K, F = table.shape
+    N = plan_fwd.num_rows
+    Hp = _pad_to(K * F, 128)
+    exact = precision == "exact"
+    c2_d, g_d, s_d, or_d = _plan_dims(plan_fwd)
+    c2_s, g_s, s_s, or_s = _plan_dims(plan_bwd)
+    np_d = 1 if g_d == 1 else 2
+    np_s = 1 if g_s == 1 else 2
+
+    zc = jnp.maximum(z[:N, :K], _Z_GUARD)
+    du = gout.astype(jnp.float32) / zc[:, :, None]
+    dz = -jnp.einsum("nkf,nkf->nk", gout.astype(jnp.float32),
+                     out.astype(jnp.float32)) / zc
+    du_flat = du.reshape(N, K * F)
+    adf = ad_l.astype(jnp.float32)
+    a = _score_matrix(a_src, K, F, Hp)
+    tflat = table.astype(jnp.float32).reshape(T, K * F)
+
+    with jax.named_scope("roc_binned_gat_bwd"):
+        # grid D: dst-keyed band rides a [OR, Hp+384] window
+        ducat = jnp.concatenate([
+            jnp.pad(du_flat, ((0, or_d - N), (0, Hp - K * F))),
+            jnp.pad(dz, ((0, or_d - N), (0, 128 - K))),
+            jnp.pad(adf, ((0, or_d - N), (0, 128 - K))),
+            m,
+        ], axis=1)
+        xp = jnp.pad(tflat, ((0, _pad_to(plan_fwd.table_rows,
+                                         geom_d.sb) - T),
+                             (0, Hp - K * F)))
+        dadl_p = _gat_bwd_dst_run(
+            xp, a, ducat, plan_fwd.f_blk, plan_fwd.f_blk2, plan_fwd.f_obi,
+            plan_fwd.f_meta, plan_fwd.f_dsrc, plan_fwd.f_ddst,
+            plan_fwd.f_rows, nsteps=s_d, c2=c2_d, out_rows=or_d,
+            interpret=interpret, geom=geom_d, K=K, F=F,
+            slope=float(slope), nparity=np_d)
+
+        # grid S: the dst-keyed band is the STAGED operand of the
+        # transposed plan (its gather side is the forward's dst rows)
+        pack = jnp.zeros((N, 128), jnp.float32)
+        pack = pack.at[:, :K].set(dz).at[:, K:2 * K].set(adf)
+        pack = pack.at[:, 2 * K:3 * K].set(m[:N, :K])
+        dd = jnp.concatenate(
+            [jnp.pad(du_flat, ((0, 0), (0, Hp - K * F))), pack], axis=1)
+        dd = jnp.pad(dd, ((0, _pad_to(plan_bwd.table_rows,
+                                      geom_s.sb) - N), (0, 0)))
+        tblp2 = jnp.pad(tflat, ((0, or_s - T), (0, Hp - K * F)))
+        dtbl_p, dast_p = _gat_bwd_src_run(
+            dd, a, tblp2, plan_bwd.f_blk, plan_bwd.f_blk2, plan_bwd.f_obi,
+            plan_bwd.f_meta, plan_bwd.f_dsrc, plan_bwd.f_ddst,
+            plan_bwd.f_rows, nsteps=s_s, c2=c2_s, out_rows=or_s,
+            interpret=interpret, exact=exact, geom=geom_s, K=K, F=F,
+            slope=float(slope), nparity=np_s)
+
+    dtable_agg = dtbl_p[:T, :K * F].reshape(T, K, F)
+    dast = dast_p[:T, :K]
+    dadl = dadl_p[:N, :K]
+    return dtable_agg, dast, dadl
+
+
+# --------------------------------------------------------------------------
+# Predicted HBM traffic (the budget-table cost model)
+# --------------------------------------------------------------------------
+
+def predicted_gat_hbm_bytes(num_rows: int, num_edges: int, heads: int,
+                            head_dim: int, fused: bool,
+                            itemsize: int = 4) -> int:
+    """Predicted HBM bytes for ONE GAT attention forward, counting only
+    the traffic the two paths do NOT share (the as_t/ad_l einsums and
+    the final out write are common).  Unfused (the plan composition):
+    every [E, K] intermediate round-trips HBM — s (1w + 2r: max pass and
+    e-build), e (1w + 2r: z and u sums), the three per-edge endpoint
+    gathers (as/ad/m: source read + materialized [E, K] chunk w + r
+    each), and the u pass materializes a gathered [E, K, F] feature
+    chunk (w + r).  Fused: staging lives in VMEM, so per-edge traffic
+    collapses to the block streams — each pass reads ~E/ch source
+    blocks of sb*Hp (x1.5 dual-block allowance) — plus the node-width
+    alpha planes (ad read twice, m w + r, z w) and window refetch."""
+    K, F = heads, head_dim
+    E, N = num_edges, num_rows
+    if not fused:
+        return (15 * E * K * itemsize          # s, e, endpoint gathers
+                + 2 * E * K * F * itemsize)    # gathered feature chunk
+    hp = _pad_to(K * F, 128)
+    ch, sb = 4096, 512                         # flat-family stream ratio
+    blocks = 2 * ((E + ch - 1) // ch) * sb * hp * itemsize * 3 // 2
+    planes = (2 * N * 128 + 3 * N * 128) * itemsize
+    return blocks + planes + N * hp * itemsize
+
+
+def predicted_gat_trainstep_hbm_bytes(num_rows: int, num_edges: int,
+                                      heads: int, head_dim: int,
+                                      fused: bool,
+                                      itemsize: int = 4) -> int:
+    """Forward + backward predicted HBM for one GAT attention layer.
+    Unfused backward: _edge_contract gathers du and table per edge and
+    the dtable pass gathers du again (3 x [E, K, F] materialized w + r),
+    the saved e/qpos residuals are read three ways, and de/dq round-trip
+    [E, K] twice each (~12 [E, K] trips).  Fused backward: two grids'
+    block streams (widths Hp and Hp+128) plus the dst-band build and
+    window traffic and the three aggregate outputs."""
+    K, F = heads, head_dim
+    E, N = num_edges, num_rows
+    fwd = predicted_gat_hbm_bytes(num_rows, num_edges, heads, head_dim,
+                                  fused, itemsize)
+    if not fused:
+        return fwd + (12 * E * K * itemsize
+                      + 6 * E * K * F * itemsize)
+    hp = _pad_to(K * F, 128)
+    ch, sb = 4096, 512
+    streams = (((E + ch - 1) // ch) * sb * (hp + (hp + 128))
+               * itemsize * 3 // 2)
+    bands = 2 * N * ((hp + 3 * 128) + (hp + 128)) * itemsize
+    outs = (2 * N * hp + 2 * N * 128) * itemsize
+    return fwd + streams + bands + outs
+
+
+def gat_plan_stats(plan: BinnedPlan):
+    """(p1_steps, p2_steps, out_rows) of a fused schedule — the budget
+    table's step-count columns (host-side; plan arrays may be device)."""
+    meta = np.asarray(plan.f_meta)
+    kinds = meta[:, 0]
+    rows = np.asarray(plan.f_rows).reshape(meta.shape[0], -1)
+    # pad steps are kind 1 with every slot masked (dstl == rb)
+    real_p2 = (rows != plan.geom.rb).any(axis=1)
+    p1 = int((kinds == 0).sum())
+    p2 = int(((kinds == 1) & real_p2).sum())
+    _, _, _, out_rows = _plan_dims(plan)
+    return p1, p2, out_rows

@@ -116,6 +116,15 @@ class ShardedGraphData:
     # single-device path feeding the same cache signature discipline.
     fusion_depth: int = dataclasses.field(default=1,
                                           metadata={"static": True})
+    # Fused GAT attention megakernel mode (round 19, ops/pallas/gat.py).
+    # Same honesty contract as megafuse/mega_bwd/fusion_depth: the sharded
+    # steps never run the fused attention kernel today — pad_binned_plans
+    # strips the f_* schedule at shard stacking, so the sharded attend
+    # closure always runs the unfused gat_attend_plan composition — but
+    # the field keys the step cache so a single-device<->sharded megafuse
+    # flip on a GAT model is provably a retrace, not a replay.
+    gat_fused: bool = dataclasses.field(default=False,
+                                        metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
@@ -124,7 +133,8 @@ jax.tree_util.register_dataclass(
                  "ring_src", "ring_dst", "plans", "gat_plans", "ring_plans",
                  "plans_local", "plans_remote"],
     meta_fields=["backend", "mode", "precision", "xch_dtype", "xch_round",
-                 "xch_comp", "megafuse", "mega_bwd", "fusion_depth"])
+                 "xch_comp", "megafuse", "mega_bwd", "fusion_depth",
+                 "gat_fused"])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -684,6 +694,10 @@ def shard_graph(part: Partition, halo: Optional[HaloMaps],
         mega_bwd=(megafuse
                   and os.environ.get("ROC_MEGA_BWD", "") != "0"),
         fusion_depth=fusion_depth,
+        # Captured at build time like mega_bwd, honest even though the
+        # sharded attend never runs the fused kernel (see field comment).
+        gat_fused=(megafuse and gat_backend == "plan"
+                   and not os.environ.get("ROC_NO_GATFUSE")),
     )
 
 

@@ -41,15 +41,23 @@ class DenseGraphData:
     in_degree: jnp.ndarray  # [N] float32
     plans: object = None    # ops.AggregatePlans for plan-based backends
     gat_plans: object = None  # ops.edge.GatPlans for plan-backend attention
+    gat_bplans: object = None  # ops.BinnedPlans for the fused GAT megakernel
     backend: str = dataclasses.field(default="xla", metadata={"static": True})
     precision: str = dataclasses.field(default="exact",
                                        metadata={"static": True})
+    # Honesty contract: gat_fused is pytree METADATA, so a gdata built with
+    # fused GAT plans attached and one without produce different treedefs —
+    # the jitted step caches key on it and a megafuse flip retraces instead
+    # of silently replaying the wrong program (mirrors spmd megafuse field).
+    gat_fused: bool = dataclasses.field(default=False,
+                                        metadata={"static": True})
 
 
 jax.tree_util.register_dataclass(
     DenseGraphData,
-    data_fields=["edge_src", "edge_dst", "in_degree", "plans", "gat_plans"],
-    meta_fields=["backend", "precision"])
+    data_fields=["edge_src", "edge_dst", "in_degree", "plans", "gat_plans",
+                 "gat_bplans"],
+    meta_fields=["backend", "precision", "gat_fused"])
 
 
 def pallas_interpret() -> bool:
@@ -146,6 +154,15 @@ def model_has_gat(model: Model) -> bool:
     return any(op.kind == "gat" for op in model.ops)
 
 
+def model_gat_dims(model: Model) -> tuple:
+    """(heads, head_dim) of the model's first gat op — the fused-kernel
+    admission shape.  (0, 0) when the model has no attention."""
+    for op in model.ops:
+        if op.kind == "gat":
+            return int(op.attrs["heads"]), int(op.attrs["head_dim"])
+    return 0, 0
+
+
 def effective_backend(config: Config, dataset: Dataset, model: Model,
                       use_edge_shard: bool = False) -> str:
     """The run's aggregation backend, model-aware: the plan-based backends
@@ -227,7 +244,9 @@ def dense_graph_data(graph, backend: str = "xla",
                      gat_backend: str = "xla",
                      storage_dtype: str = "fp32",
                      megafuse: bool = False,
-                     autotune: bool = False) -> DenseGraphData:
+                     autotune: bool = False,
+                     gat_heads: int = 0,
+                     gat_head_dim: int = 0) -> DenseGraphData:
     if autotune:
         maybe_autotune(graph.col_idx, graph.dst_idx, graph.num_nodes,
                        graph.num_nodes, storage_dtype=storage_dtype,
@@ -250,18 +269,59 @@ def dense_graph_data(graph, backend: str = "xla",
                 graph.num_nodes, geom=(geom or "auto", "auto"),
                 storage_dtype=storage_dtype, fuse_linear=megafuse)
         gat_plans = None
+        gat_bplans = None
+        gat_fused = False
         if gat_backend == "plan":
             from roc_tpu.ops.edge import build_gat_plans
             gat_plans = build_gat_plans(graph.col_idx, graph.dst_idx,
                                         graph.num_nodes, graph.num_nodes)
+            if megafuse:
+                # The fused attention megakernel rides the SAME binned plan
+                # family as aggregate->linear fusion; fuse_linear=True so
+                # choose_geometry prices flat (fusable) schedules with the
+                # fused credit.  A plan with no fused schedule (hub split,
+                # sparse fallback, bf16 staging under exact) declines below
+                # and gat_bplans stays None — the attend closure then runs
+                # the byte-identical unfused composition.
+                from roc_tpu.ops.edge import _gat_fuse_state
+                from roc_tpu.ops.pallas import gat as _pgat
+                bp = ops.build_binned_plans(
+                    graph.col_idx, graph.dst_idx, graph.num_nodes,
+                    graph.num_nodes, geom="auto",
+                    storage_dtype=storage_dtype, fuse_linear=True)
+                if gat_heads:
+                    ng, _ = _gat_fuse_state(bp, gat_heads, gat_head_dim)
+                    gat_fused = bool(ng)
+                else:
+                    gat_fused = bool(_pgat._plan_fused(bp.fwd)
+                                     and not _pgat.gat_fuse_killed())
+                if gat_fused:
+                    gat_bplans = bp
+                    if gat_heads:
+                        from roc_tpu.obs.ledger import (content_key,
+                                                        get_ledger)
+                        led = get_ledger()
+                        if led.attached:
+                            led.predict(
+                                "gat_fused_hbm_bytes",
+                                content_key(rows=int(graph.num_nodes),
+                                            edges=int(graph.num_edges),
+                                            heads=int(gat_heads),
+                                            fdim=int(gat_head_dim)),
+                                _pgat.predicted_gat_trainstep_hbm_bytes(
+                                    graph.num_nodes, graph.num_edges,
+                                    gat_heads, gat_head_dim, fused=True),
+                                "bytes")
     return DenseGraphData(
         edge_src=jnp.asarray(graph.col_idx, jnp.int32),
         edge_dst=jnp.asarray(graph.dst_idx, jnp.int32),
         in_degree=jnp.asarray(graph.in_degrees, jnp.float32),
         plans=plans,
         gat_plans=gat_plans,
+        gat_bplans=gat_bplans,
         backend=backend,
         precision=precision,
+        gat_fused=gat_fused,
     )
 
 
@@ -288,6 +348,16 @@ def make_gctx(g: DenseGraphData, num_nodes: int,
     def attend(h, a_src, a_dst, slope):
         # single device: the source table IS the local tensor
         if g.gat_plans is not None:
+            if g.gat_bplans is not None:
+                # Fused attention megakernel (ops/pallas/gat.py): per-head
+                # score->softmax->aggregate in one binned grid.  Its own
+                # trace-time decline ladder (head width, VMEM, kill
+                # switches) falls back to the oracle composition inside
+                # the custom_vjp, byte-identically.
+                return ops.gat_attend_binned(
+                    h, h, a_src, a_dst, g.gat_plans, g.gat_bplans,
+                    (g.edge_src, g.edge_dst), slope,
+                    ops.matmul_precision(g.precision), interp)
             from roc_tpu.ops.edge import gat_attend_plan
             return gat_attend_plan(h, h, a_src, a_dst, g.gat_plans,
                                    (g.edge_src, g.edge_dst), slope,
@@ -1017,12 +1087,14 @@ class Trainer(BaseTrainer):
     def _setup(self):
         ds, model = self.dataset, self.model
         backend = self._effective_backend()
+        gheads, gdim = model_gat_dims(model)
         self.gdata = dense_graph_data(
             ds.graph, backend, self.config.aggregate_precision,
             gat_backend=self._gat_backend(),
             storage_dtype="bf16" if self.config.bf16_storage else "fp32",
             megafuse=self.config.megafuse,
-            autotune=self.config.autotune)
+            autotune=self.config.autotune,
+            gat_heads=gheads, gat_head_dim=gdim)
         self.x = jnp.asarray(ds.features, self.dtype)
         self.labels = jnp.asarray(ds.onehot_labels(), jnp.float32)
         self.mask = jnp.asarray(ds.mask, jnp.int32)

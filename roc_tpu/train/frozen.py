@@ -107,13 +107,16 @@ def load_frozen(config: Config, dataset: Dataset, model: Model,
                 megafuse=config.megafuse, stream_trainer=tr)
         from roc_tpu.train.driver import (dense_graph_data,
                                           effective_backend,
-                                          effective_gat_backend)
+                                          effective_gat_backend,
+                                          model_gat_dims)
         backend = effective_backend(config, dataset, model)
+        gheads, gdim = model_gat_dims(model)
         gdata = dense_graph_data(
             dataset.graph, backend, config.aggregate_precision,
             gat_backend=effective_gat_backend(config, dataset, model),
             storage_dtype="bf16" if config.bf16_storage else "fp32",
-            megafuse=config.megafuse)
+            megafuse=config.megafuse,
+            gat_heads=gheads, gat_head_dim=gdim)
         dtype = jnp.bfloat16 if config.use_bf16 else jnp.float32
         x = jnp.asarray(dataset.features, dtype)
         params = model.init_params(jax.random.PRNGKey(config.seed))

@@ -27,7 +27,9 @@ runs it over the selftest sweep's output)::
          "knobs":     {"dma_cls": [...], "dimension_semantics": str,
                        "depth": int, "mega": 0|1,
                        "fdepth": 1|2|0 (cross-layer region cap,
-                                        absent = 1 in older stores)},
+                                        absent = 1 in older stores),
+                       "ghg": int (GAT head-stacking groups, 0 = auto,
+                                   absent = 0 in older stores)},
          "modeled_s": <stage-0 analytic seconds>,
          "trial_s":   <winning confirmation-trial seconds>,
          "source":    "surrogate" | "device"}}}}

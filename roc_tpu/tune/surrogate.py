@@ -104,12 +104,19 @@ def knob_factors(cfg) -> tuple:
       dimension_semantics "parallel": neutral (1.0) — both phases carry
         cross-step staging dependences, so until a device run proves the
         revolving-window lowering legal AND faster it cannot win a tie.
+      ghg (GAT head-stacking groups, round 19): forcing MORE groups than
+        the auto divisor multiplies the fused-attention pass count, so a
+        modest per-group overhead prior (+3% per forced group beyond the
+        first) lets the screen prefer auto/single unless a device trial
+        shows the split's smaller VMEM window wins.
     """
     ov, dma = 1.0, 1.0
     if cfg.geom.flat and tuple(cfg.dma_cls) != B._DMA_CLS:
         dma *= 0.96
     if cfg.depth == 3:
         ov *= 0.98
+    if getattr(cfg, "ghg", 0) > 1:
+        ov *= 1.0 + 0.03 * (cfg.ghg - 1)
     return ov, dma
 
 

@@ -316,19 +316,33 @@ xl_w_sub = pl.BlockSpec((1, 12, HM), lambda c: (c, 0, 0))  # sublane 12: flag
 xl_w_ok = pl.BlockSpec((1, HM, HM), lambda c: (c, 0, 0))
 xl_b_bad = pl.BlockSpec((256, 41), lambda c: (c, 0))       # raw width: flag
 xl_b_ok = pl.BlockSpec((256, HM), lambda c: (c, 0))        # VMEM boundary
+
+# fused GAT attention tiles (round 19): the head-stacked feature tiles
+# put heads x head_dim on the LANE axis, so their lane dim must be the
+# 128-padded K*F stack (gat.py HP) — a raw K*F lane is the bug class
+# _gat_sum_run's staging/window BlockSpecs must avoid; the per-head
+# alpha/max/normalizer planes ride (RB, 128) blocks (lane k = head k)
+# with the same 8-row sublane contract
+HP = 128
+gat_w_bad = pl.BlockSpec((HP, 80), lambda i: (0, i))     # raw K*F: flag
+gat_w_ok = pl.BlockSpec((HP, HP), lambda i: (0, i))      # padded stack
+gat_pl_bad = pl.BlockSpec((12, 128), lambda i: (i, 0))   # sublane 12: flag
+gat_pl_ok = pl.BlockSpec((512, 128), lambda i: (i, 0))   # alpha plane
+gat_band_ok = pl.BlockSpec((512, 512), lambda i: (i, 0))  # du|dz|ad|m band
 """
 
 
 def test_mosaic_lint_flags_fixture():
     from roc_tpu.analysis import mosaic
     fs = mosaic.lint_source(_MOSAIC_FIXTURE, "<fixture>")
-    assert len(fs) == 9, fs
+    assert len(fs) == 11, fs
     assert all(f.rule == "mosaic-align" for f in fs)
     lines = sorted(f.line for f in fs)
     # the ds(0,41), two bad BlockSpecs, the raw-H_out mega weight tile,
-    # the raw-H_in transposed weight + dx tiles, and the round-16
-    # stacked-weight (lane + sublane) and inter-layer boundary tiles
-    assert lines == [8, 13, 14, 25, 34, 36, 46, 47, 49], fs
+    # the raw-H_in transposed weight + dx tiles, the round-16
+    # stacked-weight (lane + sublane) and inter-layer boundary tiles,
+    # and the round-19 raw-K*F head-stack + alpha-plane sublane tiles
+    assert lines == [8, 13, 14, 25, 34, 36, 46, 47, 49, 59, 61], fs
 
 
 def test_mosaic_lint_waiver():
@@ -336,7 +350,7 @@ def test_mosaic_lint_waiver():
     src = _MOSAIC_FIXTURE.replace(
         "# sublane 41 % 8 != 0: flag", "# roclint: allow(mosaic-align)")
     fs = mosaic.lint_source(src, "<fixture>")
-    assert len(fs) == 8 and all(f.line > 8 for f in fs), fs
+    assert len(fs) == 10 and all(f.line > 8 for f in fs), fs
 
 
 def test_mosaic_lint_clean_on_tree():

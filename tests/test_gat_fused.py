@@ -1,0 +1,301 @@
+"""Fused GAT attention megakernel (round 19): per-head score ->
+edge softmax -> weighted aggregate in the binned Pallas grid
+(ops/pallas/gat.py), dispatched by the ``gat_attend_binned`` custom_vjp
+(ops/edge.py) with ``gat_attend_plan`` as the parity oracle.
+
+Parity strategy: the fused forward shares the oracle's ad_l einsum
+bitwise and accumulates everything fp32, so on nonnegative INTEGER data
+with a_src = 0 the edge scores, max plane, exp(0-capped) sums and the
+final divide are all exactly representable and the kernel must agree
+BITWISE with the plan composition.  Continuous data rides the norm-ULP
+bound instead (<= 32 ULPs of the output scale, forward and backward) —
+the fused kernel reassociates feature sums within fp32.  All lanes run
+``precision="highest"`` (the oracle contract -> the kernel's exact
+fp32-splitting staging); the "fast" tier's bf16 staging cast is a
+designed rounding shared with the round-8 kernels, not under test here.
+
+The decline ladder is as much the contract as the kernel: kill switch,
+VMEM-ineligible shapes, and missing bplans must all run the oracle's
+program byte for byte; ROC_GAT_BWD=0 declines ONLY the backward (fused
+forward + oracle-VJP-recompute backward).  The driver A/B pins 3-epoch
+loss parity at aggregate_precision="exact" with zero retraces — the
+``gat_fused`` static field keys the step cache.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu import ops
+from roc_tpu.graph import datasets
+from roc_tpu.models import build_gat
+from roc_tpu.ops.pallas import gat as pgat
+from roc_tpu.train.config import Config
+from roc_tpu.train.driver import Trainer
+
+EPS32 = float(np.finfo(np.float32).eps)
+
+
+def norm_ulps(a, b):
+    """|a - b|_max in units of one ULP at the array's own scale."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    scale = max(float(np.abs(a).max()), 1e-30)
+    return float(np.abs(a - b).max()) / (scale * EPS32)
+
+
+def _setup(monkeypatch, n=150, seed=3):
+    """Graph + plan pair at a shape where the flat fused schedule
+    attaches and the head-group gate admits K=2 x F=4."""
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    ds = datasets.synthetic("t", n, 4.0, 8, 4, n_train=30, n_val=30,
+                            n_test=30, seed=seed)
+    g = ds.graph
+    gplans = ops.build_gat_plans(g.col_idx, g.dst_idx, g.num_nodes,
+                                 g.num_nodes)
+    bplans = ops.build_binned_plans(g.col_idx, g.dst_idx, g.num_nodes,
+                                    g.num_nodes, geom="auto",
+                                    fuse_linear=True)
+    eidx = (jnp.asarray(g.col_idx), jnp.asarray(g.dst_idx))
+    return ds, g, gplans, bplans, eidx
+
+
+def _continuous(g, K=2, F=4, seed=7):
+    rng = np.random.default_rng(seed)
+    h = jnp.asarray(rng.normal(size=(g.num_nodes, K, F)).astype(np.float32))
+    a_src = jnp.asarray(rng.normal(size=(K, F)).astype(np.float32))
+    a_dst = jnp.asarray(rng.normal(size=(K, F)).astype(np.float32))
+    return h, a_src, a_dst
+
+
+def _spy(monkeypatch, name):
+    """Count calls into a pallas/gat entry point (edge.py calls through
+    the module object, so the patched attribute is what it resolves)."""
+    calls = []
+    orig = getattr(pgat, name)
+
+    def wrapper(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(pgat, name, wrapper)
+    return calls
+
+
+# -- forward parity --------------------------------------------------------
+
+def test_fused_forward_bitwise_on_integer_data(monkeypatch):
+    _, g, gplans, bplans, eidx = _setup(monkeypatch)
+    K, F = 2, 4
+    rng = np.random.default_rng(7)
+    h = jnp.asarray(rng.integers(0, 8, size=(g.num_nodes, K, F))
+                    .astype(np.float32))
+    a_dst = jnp.asarray(rng.integers(0, 3, size=(K, F)).astype(np.float32))
+    a_src = jnp.zeros((K, F), jnp.float32)
+    calls = _spy(monkeypatch, "run_binned_gat")
+    oracle = np.asarray(ops.gat_attend_plan(h, h, a_src, a_dst, gplans,
+                                            eidx, 0.2, "highest"))
+    fused = np.asarray(ops.gat_attend_binned(h, h, a_src, a_dst, gplans,
+                                             bplans, eidx, 0.2, "highest",
+                                             True))
+    assert calls, "fused kernel did not run (gate closed at test shape?)"
+    np.testing.assert_array_equal(fused, oracle)
+
+
+def test_fused_forward_continuous_norm_ulps(monkeypatch):
+    _, g, gplans, bplans, eidx = _setup(monkeypatch)
+    h, a_src, a_dst = _continuous(g)
+    calls = _spy(monkeypatch, "run_binned_gat")
+    oracle = ops.gat_attend_plan(h, h, a_src, a_dst, gplans, eidx, 0.2,
+                                 "highest")
+    fused = ops.gat_attend_binned(h, h, a_src, a_dst, gplans, bplans,
+                                  eidx, 0.2, "highest", True)
+    assert calls
+    assert norm_ulps(oracle, fused) <= 32
+
+
+# -- backward parity -------------------------------------------------------
+
+def _grad_pair(gplans, bplans, eidx, h, a_src, a_dst):
+    def loss_plan(h_, t_, as_, ad_):
+        return jnp.sum(jnp.sin(ops.gat_attend_plan(
+            h_, t_, as_, ad_, gplans, eidx, 0.2, "highest")))
+
+    def loss_fused(h_, t_, as_, ad_):
+        return jnp.sum(jnp.sin(ops.gat_attend_binned(
+            h_, t_, as_, ad_, gplans, bplans, eidx, 0.2, "highest", True)))
+
+    gp = jax.grad(loss_plan, argnums=(0, 1, 2, 3))(h, h, a_src, a_dst)
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(h, h, a_src, a_dst)
+    return gp, gf
+
+
+def test_fused_grads_norm_ulps(monkeypatch):
+    _, g, gplans, bplans, eidx = _setup(monkeypatch)
+    h, a_src, a_dst = _continuous(g)
+    calls = _spy(monkeypatch, "run_binned_gat_bwd")
+    gp, gf = _grad_pair(gplans, bplans, eidx, h, a_src, a_dst)
+    assert calls, "fused backward did not run (bwd gate closed?)"
+    for name, a, b in zip(("dh", "dtable", "da_src", "da_dst"), gp, gf):
+        assert norm_ulps(a, b) <= 32, name
+
+
+def test_bwd_kill_runs_fused_fwd_oracle_bwd(monkeypatch):
+    """ROC_GAT_BWD=0 declines ONLY the backward: the forward still runs
+    the fused grids, the backward recomputes the oracle VJP from the
+    saved m/z planes — grads within the same norm-ULP budget."""
+    _, g, gplans, bplans, eidx = _setup(monkeypatch)
+    h, a_src, a_dst = _continuous(g)
+    monkeypatch.setenv("ROC_GAT_BWD", "0")
+    fwd_calls = _spy(monkeypatch, "run_binned_gat")
+    bwd_calls = _spy(monkeypatch, "run_binned_gat_bwd")
+    gp, gf = _grad_pair(gplans, bplans, eidx, h, a_src, a_dst)
+    assert fwd_calls and not bwd_calls
+    for name, a, b in zip(("dh", "dtable", "da_src", "da_dst"), gp, gf):
+        assert norm_ulps(a, b) <= 32, name
+
+
+# -- decline ladder --------------------------------------------------------
+
+def test_kill_switch_declines_bitwise(monkeypatch):
+    _, g, gplans, bplans, eidx = _setup(monkeypatch)
+    h, a_src, a_dst = _continuous(g)
+    monkeypatch.setenv("ROC_NO_GATFUSE", "1")
+    calls = _spy(monkeypatch, "run_binned_gat")
+    oracle = np.asarray(ops.gat_attend_plan(h, h, a_src, a_dst, gplans,
+                                            eidx, 0.2, "highest"))
+    fused = np.asarray(ops.gat_attend_binned(h, h, a_src, a_dst, gplans,
+                                             bplans, eidx, 0.2, "highest",
+                                             True))
+    assert not calls
+    np.testing.assert_array_equal(fused, oracle)
+
+
+def test_vmem_ineligible_declines_byte_identical(monkeypatch):
+    """A shape the VMEM gate rejects must run the oracle's program byte
+    for byte — the acceptance bar for every decline rung."""
+    _, g, gplans, bplans, eidx = _setup(monkeypatch)
+    h, a_src, a_dst = _continuous(g)
+    monkeypatch.setattr(pgat, "_gat_vmem_ok", lambda *a, **k: False)
+    calls = _spy(monkeypatch, "run_binned_gat")
+    oracle = np.asarray(ops.gat_attend_plan(h, h, a_src, a_dst, gplans,
+                                            eidx, 0.2, "highest"))
+    fused = np.asarray(ops.gat_attend_binned(h, h, a_src, a_dst, gplans,
+                                             bplans, eidx, 0.2, "highest",
+                                             True))
+    assert not calls
+    np.testing.assert_array_equal(fused, oracle)
+    # grads decline to the oracle VJP as well
+    gp, gf = _grad_pair(gplans, bplans, eidx, h, a_src, a_dst)
+    for a, b in zip(gp, gf):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_missing_bplans_declines_byte_identical(monkeypatch):
+    _, g, gplans, _, eidx = _setup(monkeypatch)
+    h, a_src, a_dst = _continuous(g)
+    oracle = np.asarray(ops.gat_attend_plan(h, h, a_src, a_dst, gplans,
+                                            eidx, 0.2, "highest"))
+    fused = np.asarray(ops.gat_attend_binned(h, h, a_src, a_dst, gplans,
+                                             None, eidx, 0.2, "highest",
+                                             True))
+    np.testing.assert_array_equal(fused, oracle)
+
+
+# -- driver A/B + step-cache keying ----------------------------------------
+
+_DRV = dict(num_epochs=3, dropout_rate=0.0, learning_rate=0.01,
+            weight_decay=0.0, eval_every=10 ** 9, model="gat", heads=2,
+            aggregate_backend="matmul", aggregate_precision="exact",
+            megafuse=True)
+
+
+def _driver_leg(monkeypatch, fused):
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    if fused:
+        monkeypatch.delenv("ROC_NO_GATFUSE", raising=False)
+    else:
+        monkeypatch.setenv("ROC_NO_GATFUSE", "1")
+    ds = datasets.synthetic("t", 200, 4.0, 8, 4, n_train=30, n_val=30,
+                            n_test=30, seed=3)
+    layers = [ds.in_dim, 8, ds.num_classes]
+    cfg = Config(layers=layers, **_DRV)
+    tr = Trainer(cfg, ds, build_gat(layers, 0.0, heads=2))
+    losses = [float(tr.run_epoch()) for _ in range(3)]
+    return losses, tr
+
+
+def test_driver_ab_loss_parity(monkeypatch):
+    """3 epochs, identical init: fused vs ROC_NO_GATFUSE=1 loss parity
+    <= 1e-3 at aggregate_precision="exact" (measured ~8e-6)."""
+    lb, trb = _driver_leg(monkeypatch, fused=False)
+    lf, trf = _driver_leg(monkeypatch, fused=True)
+    assert trb.gdata.gat_bplans is None and not trb.gdata.gat_fused
+    assert trf.gdata.gat_bplans is not None and trf.gdata.gat_fused
+    assert max(abs(a - b) for a, b in zip(lb, lf)) <= 1e-3
+
+
+def test_driver_zero_retraces_with_fusion_active(monkeypatch):
+    """gat_fused is trace-time static: epochs 2..N re-enter the same
+    jitted step with the fused kernels live."""
+    from roc_tpu.analysis.retrace import RetraceGuard
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    ds = datasets.synthetic("t", 200, 4.0, 8, 4, n_train=30, n_val=30,
+                            n_test=30, seed=3)
+    layers = [ds.in_dim, 8, ds.num_classes]
+    cfg = Config(layers=layers, **_DRV)
+    tr = Trainer(cfg, ds, build_gat(layers, 0.0, heads=2))
+    assert tr.gdata.gat_fused
+    with RetraceGuard(warmup=1) as guard:
+        tr.train(print_fn=lambda *a, **k: None)
+        assert guard.counts["train_step"] >= 1
+    guard.assert_clean()
+
+
+def test_dense_step_cache_keys_on_gat_fused(monkeypatch):
+    """gat_fused rides DenseGraphData as STATIC metadata: flipping it
+    flips tree_structure, so a step traced for the fused program can
+    never serve the unfused one."""
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    ds = datasets.synthetic("t", 200, 4.0, 8, 4, n_train=30, n_val=30,
+                            n_test=30, seed=3)
+    layers = [ds.in_dim, 8, ds.num_classes]
+    tr = Trainer(Config(layers=layers, **_DRV), ds,
+                 build_gat(layers, 0.0, heads=2))
+    gd = tr.gdata
+    assert gd.gat_fused
+    flipped = dataclasses.replace(gd, gat_fused=False)
+    assert (jax.tree_util.tree_structure(gd)
+            != jax.tree_util.tree_structure(flipped))
+
+
+# -- predicted-HBM budget pins ---------------------------------------------
+
+def test_gat_budget_rows_pin():
+    """Acceptance pin: predicted fused train-step HBM <= 0.6x the plan
+    composition at every budget-table shape, and the committed
+    ``gat_fused`` rows carry exactly the predictor's numbers."""
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "kernel_budgets.json")
+    data = json.load(open(path))
+    shapes = {"reddit_scaled": (32768, 4_194_304),
+              "products_scaled": (262_144, 2_097_152),
+              "gat_shard": (1024, 8192)}
+    for shape, (n, e) in shapes.items():
+        row = data[shape]["gat_fused"]
+        K, F = row["heads"], row["head_dim"]
+        unfused = pgat.predicted_gat_trainstep_hbm_bytes(n, e, K, F,
+                                                         fused=False)
+        fused = pgat.predicted_gat_trainstep_hbm_bytes(n, e, K, F,
+                                                       fused=True)
+        assert row["hbm_trainstep_bytes_unfused"] == unfused, shape
+        assert row["hbm_trainstep_bytes_fused"] == fused, shape
+        assert fused <= 0.6 * unfused, shape
+    # the shard shape's forward gate is open and the schedule attaches
+    flat = data["gat_shard"]["gat_fused"]["flat"]
+    assert flat["attaches"] and flat["vmem_ok_fwd"]

@@ -135,6 +135,39 @@ def test_served_matches_eval_forward_gat():
         eng.close()
 
 
+def test_served_matches_eval_forward_gat_fused(tmp_path, monkeypatch):
+    """Round 19: serving inherits the fused attention megakernel for
+    free — the fused-GAT engine serves what eval computes (<= 32 ULPs),
+    a warm plan cache means zero plan rebuilds at cold start, and
+    ``gat_fused`` is pytree metadata so the step caches key on it."""
+    import dataclasses as dc
+
+    import jax
+
+    monkeypatch.setenv("ROC_BINNED_GEOM", "flat")
+    monkeypatch.setenv("ROC_PLAN_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("ROC_PLAN_CACHE_MIN_EDGES", "0")
+    ds = datasets.get("roc-audit", seed=1)
+    first = _engine(ds, model="gat", backend="binned", megafuse=True)
+    first.close()
+    eng = _engine(ds, model="gat", backend="binned", megafuse=True)
+    try:
+        gd = eng.bundle.gdata
+        assert gd.gat_bplans is not None and gd.gat_fused
+        # flipping gat_fused flips the treedef — the serve/eval jit
+        # caches therefore key on the fused mode (zero silent replays)
+        assert (jax.tree_util.tree_structure(gd)
+                != jax.tree_util.tree_structure(
+                    dc.replace(gd, gat_fused=False)))
+        assert eng.cold_start_stats["plan_builds"] == 0
+        assert eng.cold_start_stats["traces"] == 1
+        ref = np.asarray(eng.bundle.predict_logits())
+        ids = np.arange(ds.graph.num_nodes, dtype=np.int32)
+        assert max_ulp_diff(eng._serve_rows(ids), ref) <= 32
+    finally:
+        eng.close()
+
+
 def test_ulp_metric():
     a = np.float32([1.0, -2.0, 0.0])
     assert max_ulp_diff(a, a.copy()) == 0

@@ -217,6 +217,21 @@ for fd in 1 2 0; do
         -aggr-backend binned -e 10 -megafuse -fusion-depth $fd -v 2>&1 \
         | tail -2 | tee -a "$LOG"
 done
+
+note "4e. fused GAT attention A/B (round 19): same seed, plan attention"
+note "    backend, fused attention megakernel on vs ROC_NO_GATFUSE=1"
+note "    (the unfused gat_attend_plan composition).  The -v losses must"
+note "    agree to ~1e-3; the fused leg's epoch time is the round-19"
+note "    claim of record (kernel_budgets.json gat_fused predicts"
+note "    <= 0.6x unfused train-step HBM at every committed shape)."
+note "    Measured gat_fused_hbm_bytes also rides kernel_bench --filter"
+note "    gat (calibration ledger joins it to the plan-build prediction)."
+for gf in "ROC_NO_GATFUSE=1" ""; do
+    env $gf ROC_BINNED_GEOM=flat timeout 900 python -m roc_tpu \
+        -dataset mega-shard -layers 64-128-8 -model gat -heads 2 \
+        -aggr-backend matmul -e 10 -megafuse -v 2>&1 \
+        | tail -2 | tee -a "$LOG"
+done
 fi
 
 if [ "$START" -le 5 ]; then

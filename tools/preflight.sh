@@ -173,7 +173,10 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     echo "preflight: fault selftest RED" >&2; exit 1; }
 
 rm -f "$LOG"
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+# ROC_T1_TIMEOUT: the full tier-1 lane needs ~1030 s on a 1-core box
+# (PR 18 note) — the old hard-coded 870 s stopwatch lied.  Env knob so
+# slow boxes can widen it without editing the gate.
+timeout -k 10 "${ROC_T1_TIMEOUT:-1500}" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
