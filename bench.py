@@ -161,6 +161,12 @@ MEM_PLAN = os.environ.get("ROC_MEM_PLAN", "keep")
 # a different executor.  ROC_STREAM_SLOTS sets the prefetch ring depth.
 STREAM = _env("ROC_BENCH_STREAM", "0", int)
 STREAM_SLOTS = _env("ROC_STREAM_SLOTS", "2", int)
+# ROC_STREAM_SPILL=DIR (the same env Config.__post_init__ honors): the
+# boundary stores rotate through CRC'd NVMe memmaps under DIR — the
+# third storage tier.  Spill legs annotate the metric and inherit the
+# stream exclusions (a spill leg is by construction a streamed leg, so
+# vs_baseline and the canonical persist already skip it).
+STREAM_SPILL = os.environ.get("ROC_STREAM_SPILL", "")
 # ROC_BENCH_SERVE=1: after the training measurement, stand up the serving
 # engine (roc_tpu/serve) on the same graph/model and offer an open-loop
 # query load.  The artifact gains a "serve" block (p50/p99/qps/
@@ -228,6 +234,7 @@ METRIC = (f"{MODEL}_{SHAPE}{'-'.join(map(str, LAYERS))}"
           + ("" if DTYPE == "fp32" else f"_{DTYPE}")
           + ("" if FUSION == "none" else f"_{FUSION}")
           + ("" if not STREAM else f"_stream{STREAM_SLOTS}")
+          + ("" if not (STREAM and STREAM_SPILL) else "_spill")
           + ("" if not SERVE else "_serve"))
 
 # Worst case before the error JSON: 8 probes x 75 s + capped backoff
@@ -645,6 +652,11 @@ def run():
         st = getattr(trainer, "stream_stats", None)
         result["stream"] = st() if callable(st) else {
             "note": "trainer has no stream stats (fell back to in-core)"}
+        # top-level tier stamps for hw_revalidate step 5's paired legs:
+        # stream_stats carries them too when the executor ran, but the
+        # top-level copy survives the fell-back-to-in-core note above
+        result["stream_dtype"] = DTYPE
+        result["stream_spill"] = STREAM_SPILL
     if SERVE:
         # serving leg: same graph/model, the engine's own cold start (the
         # trainer above already warmed this process's plan cache, so

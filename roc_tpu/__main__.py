@@ -70,9 +70,10 @@ def main(argv=None) -> int:
             print("error: -check-sharding/-analyze audit the in-core SPMD "
                   "step; run them without -stream", file=sys.stderr)
             return 2
-        if cfg.use_bf16 or cfg.bf16_storage:
-            print("error: -stream is fp32-only for now (bf16 staging "
-                  "changes the streamed byte layout)", file=sys.stderr)
+        if cfg.use_bf16:
+            print("error: -stream computes in fp32; the streamed storage "
+                  "cut is -bf16-storage (bf16 slots, fp32 accumulation)",
+                  file=sys.stderr)
             return 2
     # Config banner, mirroring gnn.cc:48-60.
     print("        ===== GNN settings =====", file=sys.stderr)

@@ -71,6 +71,16 @@ preceding line):
     swallowing is correct.  Tests are exempt (fixtures poke error paths
     on purpose).
 
+``unpinned-host-buffer``
+    A raw numpy allocation (``np.empty/np.zeros/np.ones/np.full``, any
+    import spelling) inside ``roc_tpu/stream/`` outside the sanctioned
+    allocator module (``roc_tpu/stream/host.py``).  Streamed host stores
+    are device-bound staging: the sanctioned allocator backs them with
+    pinned zero-copy buffers where the runtime supports it, so a raw
+    ``np.zeros`` silently reintroduces the pageable-copy tax on every
+    rotation.  Host-side scratch that never ships (index maps being
+    assembled, d2h sinks) carries waivers saying so.
+
 ``hand-rolled-geometry``
     A ``Geometry(...)`` constructor call outside the sanctioned sites —
     the kernel module that owns the presets
@@ -168,6 +178,15 @@ _GEOM_EXEMPT_DIRS = (
     os.path.join("roc_tpu", "tune") + os.sep,
     "tests" + os.sep,
 )
+# Streaming tier (roc_tpu/stream/): host stores are device-bound staging
+# and must come from the pinned-capable allocator; host.py is the one
+# sanctioned constructor site (the unpinned-host-buffer rule).
+_STREAM_DIR = os.path.join("roc_tpu", "stream") + os.sep
+_STREAM_ALLOC_EXEMPT_SUFFIX = os.path.join("roc_tpu", "stream", "host.py")
+_RAW_ALLOC_CALLS = {
+    "np.empty", "np.zeros", "np.ones", "np.full",
+    "numpy.empty", "numpy.zeros", "numpy.ones", "numpy.full",
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -292,7 +311,27 @@ class _FileLint:
         self._rule_hand_rolled_geometry()
         self._rule_serve_sync()
         self._rule_silent_swallow()
+        self._rule_unpinned_host_buffer()
         return self.findings
+
+    def _rule_unpinned_host_buffer(self):
+        """Raw numpy allocations in roc_tpu/stream/ (outside the
+        sanctioned allocator, stream/host.py) — streamed stores must go
+        through the pinned-capable constructor or carry a waiver saying
+        why this buffer never stages to device."""
+        p = self.path.replace("/", os.sep)
+        if _STREAM_DIR not in p or p.endswith(_STREAM_ALLOC_EXEMPT_SUFFIX):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    _call_head(node) in _RAW_ALLOC_CALLS:
+                self._flag(
+                    node, "unpinned-host-buffer",
+                    f"raw {_call_head(node)}(...) in roc_tpu/stream/ — "
+                    "device-bound staging must use the sanctioned "
+                    "allocator (stream/host.py alloc/to_store, pinned "
+                    "zero-copy where supported); waive only for "
+                    "host-side scratch that never ships")
 
     def _rule_silent_swallow(self):
         """``except: pass`` / ``except: continue`` with no logging — the

@@ -63,6 +63,10 @@ DP_MAX_LAYERS = 16
 # Host-DMA round-trip bandwidth used only to flag offload candidates
 # (PCIe-class; deliberately conservative).
 OFFLOAD_BYTES_PER_S = 5e10
+# NVMe-class round-trip bandwidth for the spill tier (-stream-spill):
+# when boundary stores live on disk, an OFFLOAD verdict's bytes pay the
+# slower device, so fewer layers clear the recompute-beats-transfer bar.
+SPILL_BYTES_PER_S = 3e9
 
 
 @dataclasses.dataclass(frozen=True)
@@ -252,12 +256,14 @@ def _plan_greedy(est: ModelEstimate, budget_bytes: int):
     return decisions
 
 
-def _mark_offload(est: ModelEstimate, decisions):
-    """Relabel remats whose host round-trip would beat recomputing."""
+def _mark_offload(est: ModelEstimate, decisions,
+                  bytes_per_s: float = OFFLOAD_BYTES_PER_S):
+    """Relabel remats whose round-trip to the offload tier (host DMA by
+    default, NVMe under the spill tier) would beat recomputing."""
     out = []
     for l, d in zip(est.layers, decisions):
         if d == REMAT:
-            transfer = 2.0 * l.bytes_saved / OFFLOAD_BYTES_PER_S
+            transfer = 2.0 * l.bytes_saved / bytes_per_s
             if transfer < l.recompute_full_s - l.recompute_cheap_s:
                 d = OFFLOAD
         out.append(d)
@@ -266,7 +272,8 @@ def _mark_offload(est: ModelEstimate, decisions):
 
 def plan_memory(est: ModelEstimate, mode: str = "auto",
                 budget_bytes: int = 0,
-                offload_executed: bool = False) -> MemPlan:
+                offload_executed: bool = False,
+                offload_spills: bool = False) -> MemPlan:
     """Compile a :class:`MemPlan` for the given estimates.
 
     ``mode="keep"`` / ``"remat"`` pin every layer (budget ignored);
@@ -274,6 +281,9 @@ def plan_memory(est: ModelEstimate, mode: str = "auto",
     makes all-KEEP optimal by construction).  ``offload_executed`` records
     whether this run's executor actually moves OFFLOAD bytes to host
     (the stream executor does; the in-core ones execute them as remat).
+    ``offload_spills`` prices the round-trip at the NVMe tier
+    (-stream-spill: boundary stores live on disk, so OFFLOAD's bytes ride
+    the slower device and must beat recompute at SPILL_BYTES_PER_S).
     """
     L = len(est.layers)
     if mode == "keep":
@@ -284,7 +294,9 @@ def plan_memory(est: ModelEstimate, mode: str = "auto",
         decisions, planner = _plan_auto(est, int(budget_bytes))
     else:
         raise ValueError(f"mem plan mode {mode!r}: must be keep|remat|auto")
-    decisions = _mark_offload(est, decisions)
+    decisions = _mark_offload(
+        est, decisions,
+        SPILL_BYTES_PER_S if offload_spills else OFFLOAD_BYTES_PER_S)
     all_keep, all_remat = [KEEP] * L, [REMAT] * L
     return MemPlan(
         mode=mode, budget_bytes=int(budget_bytes),
@@ -298,7 +310,9 @@ def plan_memory(est: ModelEstimate, mode: str = "auto",
         remat_step_s=predict_time(est, all_remat),
         planner=planner,
         feasible=feasible(est, decisions, int(budget_bytes)),
-        offload_executes_as="stream-host" if offload_executed else REMAT,
+        offload_executes_as=("stream-spill" if offload_executed
+                             and offload_spills else
+                             "stream-host" if offload_executed else REMAT),
     )
 
 

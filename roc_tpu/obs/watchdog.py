@@ -61,6 +61,10 @@ class PerfWatchdog:
         # host->device prefetch; stream executor runs only)
         self.stall_ewma: Optional[float] = None
         self.stall_observed = 0
+        # spill-stall EWMA (fraction of epoch wall spent blocked on
+        # boundary-store spill writes; -stream-spill runs only)
+        self.spill_ewma: Optional[float] = None
+        self.spill_observed = 0
         # serving p99-latency EWMA (serve engine runs only)
         self.serve_ewma: Optional[float] = None
         self.serve_observed = 0
@@ -80,7 +84,8 @@ class PerfWatchdog:
 
     # -- checkpoint round trip (roc_tpu/fault crash-consistent resume) ----
     _STATE_KEYS = ("ewma", "observed", "seeded", "stall_ewma",
-                   "stall_observed", "serve_ewma", "serve_observed",
+                   "stall_observed", "spill_ewma", "spill_observed",
+                   "serve_ewma", "serve_observed",
                    "delta_ewma", "delta_observed",
                    "fleet_ewma", "fleet_observed",
                    "calib_ewma", "calib_observed", "nonfinite_steps")
@@ -148,6 +153,35 @@ class PerfWatchdog:
             self.stall_ewma = frac if self.stall_ewma is None else \
                 self.alpha * frac + (1.0 - self.alpha) * self.stall_ewma
         self.stall_observed += 1
+        return alert
+
+    def observe_spill(self, epoch: int,
+                      stall_frac: float) -> Optional[dict]:
+        """Feed one spilled epoch's spill-stall fraction (stream executor
+        under -stream-spill: boundary-store write seconds / epoch wall —
+        the reads overlap on the prefetch ring, the writes block the
+        consumer).  Alert when it exceeds ``ratio`` x its own EWMA: the
+        signal that the spill device stopped keeping up (NVMe throttling,
+        a full page cache flushing synchronously, a competing writer).
+        Near-zero baselines floored and epoch 0 excluded, mirroring
+        observe_stream."""
+        frac = float(stall_frac)
+        armed = self.spill_ewma is not None and \
+            self.spill_observed >= self.warmup
+        baseline = max(self.spill_ewma or 0.0, 0.02)
+        alert = None
+        if armed and frac > self.ratio * baseline:
+            alert = {"kind": "spill-stall", "epoch": int(epoch),
+                     "stall_frac": frac, "ewma": float(self.spill_ewma),
+                     "ratio": frac / baseline}
+            self.alerts.append(alert)
+            frac = self.ratio * baseline  # clamp, as observe_epoch does
+        if self.spill_observed >= 1:
+            # epoch 0 pays first-touch page faults for every store while
+            # the jit compiles; never let it set the baseline
+            self.spill_ewma = frac if self.spill_ewma is None else \
+                self.alpha * frac + (1.0 - self.alpha) * self.spill_ewma
+        self.spill_observed += 1
         return alert
 
     def observe_serve(self, window: int, p99_s: float) -> Optional[dict]:
@@ -288,9 +322,9 @@ class PerfWatchdog:
     def verdict(self) -> str:
         """"nonfinite" outranks everything (numerics beat perf), then
         "regressed" if any slow-epoch fired, then "straggler", then
-        "stream-stall", then "serve-latency", then "delta-apply", then
-        "fleet-lag", then "calibration-drift", "ok" otherwise — stamped
-        into bench artifacts."""
+        "stream-stall", then "spill-stall", then "serve-latency", then
+        "delta-apply", then "fleet-lag", then "calibration-drift", "ok"
+        otherwise — stamped into bench artifacts."""
         kinds = {a["kind"] for a in self.alerts}
         if "nonfinite" in kinds:
             return "nonfinite"
@@ -300,6 +334,8 @@ class PerfWatchdog:
             return "straggler"
         if "stream-stall" in kinds:
             return "stream-stall"
+        if "spill-stall" in kinds:
+            return "spill-stall"
         if "serve-latency" in kinds:
             return "serve-latency"
         if "delta-apply" in kinds:

@@ -32,9 +32,29 @@ Parity: per-shard loss terms and metric tallies are pure sums
 the in-core step up to float reassociation; Adam (weight decay included)
 then applies the identical update.  tests/test_stream.py holds the 3-epoch
 loss gap under 1e-3.
+
+Storage tiers: under ``-bf16-storage`` every float wire — host stores,
+slot ``device_put``s, boundary outputs, the cotangent fetch — rides
+bf16 with the wire codec's one-rounding-per-row nearest contract
+(parallel/spmd.py precedent): values round exactly once at a store/wire
+boundary, and all arithmetic (segment compute, loss, ``np.add.at``
+cotangent accumulation, Adam) stays fp32.  Integer-valued data is bf16-
+exact, so streamed-bf16 matches fp32 streaming bitwise on integer
+features (tests/test_stream_tiers.py pins it).  The bf16 layout also
+narrows the edge-index wire to uint16 when the local+halo table fits in
+16 bits.  Host stores come from the sanctioned allocator
+(stream/host.py): pinned zero-copy buffers when the backend has a
+pinned_host space, plain numpy otherwise.  Under ``-stream-spill DIR``
+the boundary-activation and cotangent stores drop to a third tier —
+CRC-headered memmaps on disk (stream/spill.py) — and the ring prefetches
+slot i+1's spill read behind slot i's compute exactly like device
+staging (``stream_spill_*`` spans/stats; write time that blocks the
+consumer feeds the watchdog's spill-stall EWMA).
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -48,8 +68,11 @@ from roc_tpu.graph.csr import Csr
 from roc_tpu.graph.lux import LUX_SUFFIX
 from roc_tpu.graph.partition import _round_up, partition_graph
 from roc_tpu.ops.softmax import MASK_NONE
+from roc_tpu.stream import host as stream_host
+from roc_tpu.stream import spill as stream_spill
 from roc_tpu.stream.ring import PrefetchRing
-from roc_tpu.stream.segments import run_segment, split_segments
+from roc_tpu.stream.segments import (predicted_epoch_bytes, run_segment,
+                                     split_segments)
 from roc_tpu.train.driver import BaseTrainer
 
 __all__ = ["StreamTrainer"]
@@ -95,8 +118,11 @@ def _stream_maps(meta, edge_src, K_force=None):
                 f"K={K_force}; restart -stream to rebuild the slot shapes")
         K = int(K_force)
 
-    tbl_idx = np.empty((P, S + P * K), np.int64)
-    esrc_local = np.empty((P, E), np.int32)
+    # host-side map assembly scratch: tbl_idx indexes host stores and
+    # never ships; esrc_local is copied into a sanctioned store by
+    # _install_graph before any device staging
+    tbl_idx = np.empty((P, S + P * K), np.int64)     # roclint: allow(unpinned-host-buffer) — gather-index scratch, never staged
+    esrc_local = np.empty((P, E), np.int32)          # roclint: allow(unpinned-host-buffer) — copied into a host.to_store buffer before staging
     owners_base = np.repeat(np.arange(P, dtype=np.int64) * S + (S - 1), K)
     for i in range(P):
         halo = owners_base.copy()
@@ -115,6 +141,17 @@ def _stream_maps(meta, edge_src, K_force=None):
     return K, tbl_idx, esrc_local
 
 
+def _f32(x):
+    """Device-side upcast to the fp32 compute dtype.  On fp32 wires this
+    is the identity (jnp.astype to the same dtype inserts no convert), so
+    fp32 streaming compiles to the exact pre-bf16 programs."""
+    return x.astype(jnp.float32)
+
+
+def _f32d(d):
+    return {t: _f32(v) for t, v in d.items()}
+
+
 class StreamTrainer(BaseTrainer):
     """Host-streaming trainer: fixed device slots, rotating shards."""
 
@@ -122,9 +159,21 @@ class StreamTrainer(BaseTrainer):
 
     def _setup(self):
         cfg, ds = self.config, self.dataset
-        if self.dtype != jnp.float32 or cfg.bf16_storage:
-            raise SystemExit("error: -stream is fp32-only for now (bf16 "
-                             "staging changes the streamed byte layout)")
+        if self.dtype != jnp.float32:
+            raise SystemExit("error: -stream computes in fp32 (-bf16 casts "
+                             "the whole model; use -bf16-storage to stream "
+                             "bf16 slots with fp32 compute)")
+        if cfg.bf16_storage and (cfg.bf16_rounding != "nearest"
+                                 or cfg.bf16_exchange != "plain"):
+            raise SystemExit("error: -stream bf16 slots implement the "
+                             "nearest/plain wire contract only (stochastic "
+                             "rounding and compensated exchange live in the "
+                             "shard_map collective codec)")
+        self._sdtype = np.dtype(jnp.bfloat16) if cfg.bf16_storage \
+            else np.dtype(np.float32)
+        self._spill_dir = str(cfg.stream_spill or "")
+        if self._spill_dir:
+            os.makedirs(self._spill_dir, exist_ok=True)
         P = int(cfg.num_parts)
         if P < 2:
             raise SystemExit("error: -stream needs -parts >= 2 (one slot "
@@ -168,6 +217,10 @@ class StreamTrainer(BaseTrainer):
         self._scatter_futs = []
         self._scatter_s = 0.0
         self._scatter_wait_s = 0.0
+        self._spill_read_s = 0.0
+        self._spill_write_s = 0.0
+        self._spill_read_bytes = 0
+        self._spill_write_bytes = 0
         self._logits_sink = None
         self._epoch_stream = []
         self._last_stream_stats = None
@@ -181,9 +234,15 @@ class StreamTrainer(BaseTrainer):
             self._wire_key = content_key(parts=self._P,
                                          segments=self._nseg,
                                          slots=int(cfg.stream_slots))
-            led.predict("wire_bytes", self._wire_key,
-                        self._predicted_epoch_xfer_bytes(), "bytes")
+            pred_bytes = self._predicted_epoch_xfer_bytes()
+            led.predict("wire_bytes", self._wire_key, pred_bytes, "bytes")
             led.predict("overlap_frac", self._wire_key, 1.0, "frac")
+            # pinned-store transfer-time model: the epoch's staged bytes
+            # over the assumed host<->device bandwidth (stream/host.py;
+            # ROC_STREAM_BW_BYTES calibrates), paired against the ring's
+            # measured transfer seconds each epoch
+            led.predict("stream_xfer_s", self._wire_key,
+                        pred_bytes / stream_host.STREAM_BW_BYTES_S, "s")
         if cfg.verbose:
             budget = cfg.stream_budget_bytes()
             held = cfg.stream_slots * self.slot_bytes()
@@ -191,9 +250,14 @@ class StreamTrainer(BaseTrainer):
             if budget:
                 note = (f" vs budget {budget / 2**20:.0f} MiB "
                         f"({'fits' if held <= budget else 'OVER'})")
+            tier = "bf16" if self._sdtype.itemsize == 2 else "fp32"
+            if self._spill_dir:
+                tier += f"+spill({self._spill_dir})"
             print(f"# stream: {P} shards x {self._nseg} segments through "
                   f"{cfg.stream_slots} slots, ~{held / 2**20:.1f} MiB "
-                  f"device-resident{note}, halo K={self._K}")
+                  f"device-resident{note}, halo K={self._K}, {tier} slots, "
+                  f"{'pinned' if stream_host.pinned_supported() else 'pageable'}"
+                  " host stores")
 
     def _load_lux_shards(self, meta):
         shards = shard_load.load_local_shards(
@@ -214,73 +278,91 @@ class StreamTrainer(BaseTrainer):
         K, tbl_idx, esrc_local = _stream_maps(meta, edge_src, K_force)
         self._K = K
         self._tbl_idx = tbl_idx
-        self._esrc = esrc_local
-        self._edst = np.asarray(edge_dst, np.int32)
-        self._indeg = np.asarray(in_degree, np.float32)
+        # The compact bf16 wire also narrows edge indices to uint16 when
+        # they fit (table rows for esrc, shard rows for edst) — the jitted
+        # steps upcast to int32 on device.  fp32 streaming keeps the int32
+        # wire so its byte layout is unchanged from the fp32-only era.
+        compact = self._sdtype.itemsize < 4
+        esrc_dt = np.uint16 if compact and self._S + self._P * K <= 1 << 16 \
+            else np.int32
+        edst_dt = np.uint16 if compact and self._S <= 1 << 16 else np.int32
+        self._esrc = stream_host.to_store(esrc_local.astype(esrc_dt))
+        self._edst = stream_host.to_store(
+            np.asarray(edge_dst).astype(edst_dt))
+        self._indeg = stream_host.to_store(
+            np.asarray(in_degree, np.float32))
         self._edges_valid = jnp.asarray(
             np.asarray(meta.num_edges_valid), jnp.int32)
         ds = self.dataset
-        self._store_x = np.asarray(meta.pad_nodes(ds.features), np.float32)
-        self._labels = np.asarray(
-            meta.pad_nodes(ds.onehot_labels()), np.float32)
-        self._mask = np.asarray(
-            meta.pad_nodes(np.asarray(ds.mask), fill=MASK_NONE), np.int32)
+        # one nearest rounding per row at load — the storage-dtype contract
+        self._store_x = stream_host.to_store(
+            np.asarray(meta.pad_nodes(ds.features), self._sdtype))
+        self._labels = stream_host.to_store(np.asarray(
+            meta.pad_nodes(ds.onehot_labels()), self._sdtype))
+        self._mask = stream_host.to_store(np.asarray(
+            meta.pad_nodes(np.asarray(ds.mask), fill=MASK_NONE), np.int32))
         if hasattr(self, "_stores"):
             self._stores[0] = self._store_x
 
     def _alloc_stores(self):
-        """Host stores for segment-boundary activations and their
-        cotangents; tid 0 aliases the padded feature store."""
+        """Stores for segment-boundary activations and their cotangents;
+        tid 0 aliases the padded feature store.  Activations live in the
+        storage dtype; cotangent stores stay fp32 host-side because
+        ``np.add.at`` accumulates partial sums there (the bf16 contract
+        rounds the *wire*, in ``_fetch``, never the accumulator).  Under
+        -stream-spill both move to CRC-headered memmaps on disk — they
+        are the stores that scale with model depth, which is what the
+        third tier exists to absorb."""
         PS = self._P * self._S
         dims = {}
         for seg in self.segments:
             dims.update(seg.out_dims)
         self._stores = {0: self._store_x}
         self._cots = {}
+        self._spill_tids = set()
         for seg in self.segments:
             for t in seg.out_tids:
-                self._stores[t] = np.zeros((PS, dims[t]), np.float32)
-                self._cots[t] = np.zeros((PS, dims[t]), np.float32)
+                if self._spill_dir:
+                    self._stores[t] = stream_spill.create_store(
+                        os.path.join(self._spill_dir, f"act{t}.spill"),
+                        (PS, dims[t]), self._sdtype)
+                    self._cots[t] = stream_spill.create_store(
+                        os.path.join(self._spill_dir, f"cot{t}.spill"),
+                        (PS, dims[t]), np.float32)
+                    self._spill_tids.add(t)
+                else:
+                    self._stores[t] = stream_host.alloc(
+                        (PS, dims[t]), self._sdtype)
+                    self._cots[t] = stream_host.alloc(
+                        (PS, dims[t]), np.float32)
 
     def _predicted_epoch_xfer_bytes(self) -> int:
-        """Analytic bytes ``_fetch`` ships in one training epoch: the
-        sweep schedule ((nseg-1) fwd + nseg bwd), each sweep rotating all
-        P shards, priced from the same store shapes ``_fetch`` slices.
-        PRNG keys (a few device words per fetch) are not counted."""
-        n = self._nseg
-        total = 0
-        sweeps = [("fwd", k) for k in range(n - 1)] + \
-                 [("bwd", k) for k in range(n - 1, -1, -1)]
-        for phase, k in sweeps:
-            seg = self.segments[k]
-            for i in range(self._P):
-                b = (self._esrc[i].nbytes + self._edst[i].nbytes
-                     + self._indeg[i].nbytes)
-                if seg.head is not None:
-                    b += (len(self._tbl_idx[i])
-                          * self._stores[seg.table_tid].shape[1] * 4)
-                for t in seg.own_in_tids:
-                    b += self._S * self._stores[t].shape[1] * 4
-                if seg.is_last:
-                    b += self._S * (self._labels.shape[1] * 4 + 4)
-                if phase == "bwd" and not seg.is_last:
-                    for t in seg.out_tids:
-                        b += self._S * self._cots[t].shape[1] * 4
-                total += b
-        return int(total)
+        """Analytic bytes ``_fetch`` ships in one training epoch, priced
+        by the shared ``segments.predicted_epoch_bytes`` model from the
+        live store itemsizes (so bf16 slots and the uint16 edge wire are
+        reflected, and the kernel-budget gate prices the same way)."""
+        return predicted_epoch_bytes(
+            self.segments, self._P, self._S, self._E, self._K,
+            self.dataset.num_classes,
+            act_itemsize=self._sdtype.itemsize,
+            esrc_itemsize=self._esrc.itemsize,
+            edst_itemsize=self._edst.itemsize)
 
     def slot_bytes(self) -> int:
         """Worst-case bytes one device slot holds (table + own rows +
         outputs + edge arrays) — what -stream-budget should be sized to,
-        times the ring depth."""
+        times the ring depth.  Staged inputs ride the storage dtype (and
+        the narrow edge wire); compute upcasts are transient and outputs
+        accumulate fp32 on device, so outputs price at 4 bytes."""
         S, E, T = self._S, self._E, self._S + self._P * self._K
+        ai = self._sdtype.itemsize
         worst = 0
         for seg in self.segments:
-            b = E * 8 + S * 4  # esrc + edst int32, indeg f32
+            b = E * (self._esrc.itemsize + self._edst.itemsize) + S * 4
             if seg.head is not None:
-                b += T * seg.out_dims[seg.table_tid] * 4
+                b += T * seg.out_dims[seg.table_tid] * ai
             for t in seg.own_in_tids:
-                b += S * seg.out_dims[t] * 4
+                b += S * seg.out_dims[t] * ai
             for t in seg.out_tids:
                 b += 2 * S * seg.out_dims[t] * 4  # value + cotangent
             worst = max(worst, b)
@@ -314,23 +396,36 @@ class StreamTrainer(BaseTrainer):
 
     def _make_fwd(self, seg):
         S, outs, name = self._S, seg.out_tids, f"stream_fwd{seg.index}"
+        sd = jnp.dtype(self._sdtype)  # boundary outputs ride the wire dtype
         if seg.head is None:
             @jax.jit
             def fwd(params, own, esrc, edst, indeg, key):
                 _retrace.note_trace(name)
-                vals = run_segment(seg, params, None, own, esrc, edst,
+                vals = run_segment(seg, params, None, _f32d(own),
+                                   esrc.astype(jnp.int32),
+                                   edst.astype(jnp.int32),
                                    indeg, key, True, S)
-                return {t: vals[t] for t in outs}
+                return {t: vals[t].astype(sd) for t in outs}
         else:
             @jax.jit
             def fwd(params, table, own, esrc, edst, indeg, key):
                 _retrace.note_trace(name)
-                vals = run_segment(seg, params, table, own, esrc, edst,
+                vals = run_segment(seg, params, _f32(table), _f32d(own),
+                                   esrc.astype(jnp.int32),
+                                   edst.astype(jnp.int32),
                                    indeg, key, True, S)
-                return {t: vals[t] for t in outs}
+                return {t: vals[t].astype(sd) for t in outs}
         return fwd
 
     def _make_bwd(self, seg):
+        """Backward step.  Upcasts happen *inside* the differentiated
+        function, so we differentiate with respect to the storage-dtype
+        table/own inputs: the returned dt/down cotangents come back in
+        the storage dtype (halving the device->host scatter pull under
+        bf16, one nearest rounding per row), and the host-side fp32
+        cotangent stores accumulate the upcast values.  Fetched cots
+        upcast to fp32 before seeding the vjp (the primal outs are
+        fp32)."""
         S, name = self._S, f"stream_bwd{seg.index}"
         logits_tid = self.model.logits.id
         if seg.is_last:
@@ -338,12 +433,14 @@ class StreamTrainer(BaseTrainer):
                 @jax.jit
                 def bwd(params, own, esrc, edst, indeg, key, labels, mask):
                     _retrace.note_trace(name)
+                    es, ed = esrc.astype(jnp.int32), edst.astype(jnp.int32)
+                    lab = _f32(labels)
 
                     def f(p, ow):
-                        vals = run_segment(seg, p, None, ow, esrc, edst,
+                        vals = run_segment(seg, p, None, _f32d(ow), es, ed,
                                            indeg, key, True, S)
                         return ops.masked_softmax_cross_entropy(
-                            vals[logits_tid], labels, mask)
+                            vals[logits_tid], lab, mask)
 
                     loss, (dp, down) = jax.value_and_grad(
                         f, argnums=(0, 1))(params, own)
@@ -353,12 +450,14 @@ class StreamTrainer(BaseTrainer):
                 def bwd(params, table, own, esrc, edst, indeg, key,
                         labels, mask):
                     _retrace.note_trace(name)
+                    es, ed = esrc.astype(jnp.int32), edst.astype(jnp.int32)
+                    lab = _f32(labels)
 
                     def f(p, tab, ow):
-                        vals = run_segment(seg, p, tab, ow, esrc, edst,
-                                           indeg, key, True, S)
+                        vals = run_segment(seg, p, _f32(tab), _f32d(ow),
+                                           es, ed, indeg, key, True, S)
                         return ops.masked_softmax_cross_entropy(
-                            vals[logits_tid], labels, mask)
+                            vals[logits_tid], lab, mask)
 
                     loss, (dp, dt, down) = jax.value_and_grad(
                         f, argnums=(0, 1, 2))(params, table, own)
@@ -369,66 +468,79 @@ class StreamTrainer(BaseTrainer):
                 @jax.jit
                 def bwd(params, own, esrc, edst, indeg, key, cots):
                     _retrace.note_trace(name)
+                    es, ed = esrc.astype(jnp.int32), edst.astype(jnp.int32)
 
                     def f(p, ow):
-                        vals = run_segment(seg, p, None, ow, esrc, edst,
+                        vals = run_segment(seg, p, None, _f32d(ow), es, ed,
                                            indeg, key, True, S)
                         return {t: vals[t] for t in outs}
 
                     _, vjp = jax.vjp(f, params, own)
-                    dp, down = vjp(cots)
+                    dp, down = vjp(_f32d(cots))
                     return dp, None, down
             else:
                 @jax.jit
                 def bwd(params, table, own, esrc, edst, indeg, key, cots):
                     _retrace.note_trace(name)
+                    es, ed = esrc.astype(jnp.int32), edst.astype(jnp.int32)
 
                     def f(p, tab, ow):
-                        vals = run_segment(seg, p, tab, ow, esrc, edst,
-                                           indeg, key, True, S)
+                        vals = run_segment(seg, p, _f32(tab), _f32d(ow),
+                                           es, ed, indeg, key, True, S)
                         return {t: vals[t] for t in outs}
 
                     _, vjp = jax.vjp(f, params, table, own)
-                    dp, dt, down = vjp(cots)
+                    dp, dt, down = vjp(_f32d(cots))
                     return dp, dt, down
         return bwd
 
     def _make_eval(self, seg):
         S, name = self._S, f"stream_eval{seg.index}"
+        sd = jnp.dtype(self._sdtype)
         if seg.is_last:
             logits_tid = self.model.logits.id
             if seg.head is None:
                 @jax.jit
                 def ev(params, own, esrc, edst, indeg, labels, mask):
                     _retrace.note_trace(name)
-                    vals = run_segment(seg, params, None, own, esrc, edst,
+                    vals = run_segment(seg, params, None, _f32d(own),
+                                       esrc.astype(jnp.int32),
+                                       edst.astype(jnp.int32),
                                        indeg, None, False, S)
                     logits = vals[logits_tid]
-                    return logits, ops.perf_metrics(logits, labels, mask)
+                    return logits, ops.perf_metrics(logits, _f32(labels),
+                                                    mask)
             else:
                 @jax.jit
                 def ev(params, table, own, esrc, edst, indeg, labels, mask):
                     _retrace.note_trace(name)
-                    vals = run_segment(seg, params, table, own, esrc, edst,
+                    vals = run_segment(seg, params, _f32(table), _f32d(own),
+                                       esrc.astype(jnp.int32),
+                                       edst.astype(jnp.int32),
                                        indeg, None, False, S)
                     logits = vals[logits_tid]
-                    return logits, ops.perf_metrics(logits, labels, mask)
+                    return logits, ops.perf_metrics(logits, _f32(labels),
+                                                    mask)
         else:
             outs = seg.out_tids
             if seg.head is None:
                 @jax.jit
                 def ev(params, own, esrc, edst, indeg):
                     _retrace.note_trace(name)
-                    vals = run_segment(seg, params, None, own, esrc, edst,
+                    vals = run_segment(seg, params, None, _f32d(own),
+                                       esrc.astype(jnp.int32),
+                                       edst.astype(jnp.int32),
                                        indeg, None, False, S)
-                    return {t: vals[t] for t in outs}
+                    return {t: vals[t].astype(sd) for t in outs}
             else:
                 @jax.jit
                 def ev(params, table, own, esrc, edst, indeg):
                     _retrace.note_trace(name)
-                    vals = run_segment(seg, params, table, own, esrc, edst,
+                    vals = run_segment(seg, params, _f32(table), _f32d(own),
+                                       esrc.astype(jnp.int32),
+                                       edst.astype(jnp.int32),
                                        indeg, None, False, S)
-                    return {t: vals[t] for t in outs}
+                    return {t: vals[t].astype(sd) for t in outs}
         return ev
 
     # -- host<->device staging ---------------------------------------------
@@ -444,9 +556,15 @@ class StreamTrainer(BaseTrainer):
         a = {"esrc": self._esrc[i], "edst": self._edst[i],
              "indeg": self._indeg[i]}
         if seg.head is not None:
-            with obs.span("stream_gather", seg=k, shard=i):
-                a["table"] = self._stores[seg.table_tid][self._tbl_idx[i]]
-        a["own"] = {t: self._stores[t][lo:lo + S]
+            tid = seg.table_tid
+            with obs.span("stream_gather", seg=k, shard=i) as gsp:
+                a["table"] = self._stores[tid][self._tbl_idx[i]]
+            if tid in self._spill_tids:
+                # the fancy-index gather above just paged the table rows
+                # off the spill memmap; attribute it to the spill tier
+                self._spill_read_s += gsp.dur_s
+                self._spill_read_bytes += a["table"].nbytes
+        a["own"] = {t: self._pull_rows(self._stores[t], t, lo, i)
                     for t in seg.own_in_tids}
         if phase != "eval":
             a["key"] = self._keys[i]
@@ -454,7 +572,12 @@ class StreamTrainer(BaseTrainer):
             a["labels"] = self._labels[lo:lo + S]
             a["mask"] = self._mask[lo:lo + S]
         if phase == "bwd" and not seg.is_last:
-            a["cots"] = {t: self._cots[t][lo:lo + S] for t in seg.out_tids}
+            # the cotangent wire rides the storage dtype: one nearest
+            # rounding per row here, fp32 accumulation left behind in the
+            # host store
+            a["cots"] = {t: self._pull_rows(self._cots[t], t, lo, i,
+                                            out_dtype=self._sdtype)
+                         for t in seg.out_tids}
         self._xfer_bytes += sum(
             getattr(v, "nbytes", 0) for v in jax.tree_util.tree_leaves(a))
         with obs.span("stream_transfer", seg=k, shard=i):
@@ -462,6 +585,23 @@ class StreamTrainer(BaseTrainer):
             a = jax.device_put(a)             # h2d failure is retried by
             jax.block_until_ready(a)          # the ring's fetch wrapper
         return a
+
+    def _pull_rows(self, store, tid, lo, shard, out_dtype=None):
+        """One shard's rows from a host or spill store, on the ring's
+        worker.  RAM-tier same-dtype pulls ship the store view directly
+        (zero copy — the pinned allocator is what makes that DMA-able);
+        spill-tier pulls force the disk read here, under their own span,
+        so the prefetch overlap of the third tier is measured honestly
+        rather than smeared into device_put."""
+        view = store[lo:lo + self._S]
+        dt = np.dtype(out_dtype) if out_dtype is not None else view.dtype
+        if tid in self._spill_tids:
+            with obs.span("stream_spill_read", tid=tid, shard=shard) as sp:
+                out = np.array(view, dtype=dt)  # copy=True: page it in now
+            self._spill_read_s += sp.dur_s
+            self._spill_read_bytes += out.nbytes
+            return out
+        return np.asarray(view, dt) if dt != view.dtype else view
 
     def _sweep(self, phase, k, consume):
         """Rotate all P shards of one (phase, segment) sweep through the
@@ -478,9 +618,23 @@ class StreamTrainer(BaseTrainer):
                 consume(it[2], a)
 
     def _write_outs(self, i, outs):
+        """Persist one shard's boundary outputs.  The device already
+        rounded them to the storage dtype, so the store assignment is an
+        exact copy; spill-tier writes get their own span (they block the
+        consumer, which is what the spill-stall watchdog signal keys on)."""
         lo = i * self._S
-        for t, arr in jax.device_get(outs).items():
-            self._stores[t][lo:lo + self._S] = arr
+        outs = jax.device_get(outs)
+        spilled = [t for t in outs if t in self._spill_tids]
+        for t, arr in outs.items():
+            if t not in self._spill_tids:
+                self._stores[t][lo:lo + self._S] = arr
+        if spilled:
+            with obs.span("stream_spill_write", shard=i,
+                          tids=len(spilled)) as sp:
+                for t in spilled:
+                    self._stores[t][lo:lo + self._S] = outs[t]
+            self._spill_write_s += sp.dur_s
+            self._spill_write_bytes += sum(outs[t].nbytes for t in spilled)
 
     def _scatter_table(self, seg, i, dt):
         cot = self._cots.get(seg.table_tid)
@@ -505,8 +659,11 @@ class StreamTrainer(BaseTrainer):
         def work():
             def _pull():
                 fault.point("stream.scatter")
-                dt_h = None if dt is None else np.asarray(dt)
-                down_h = {t: np.asarray(arr)
+                # pulls come back in the storage dtype (bf16 halves the
+                # d2h wire); upcast here so the fp32 host accumulators
+                # never see a rounded partial sum
+                dt_h = None if dt is None else np.asarray(dt, np.float32)
+                down_h = {t: np.asarray(arr, np.float32)
                           for t, arr in (down or {}).items()}
                 return dt_h, down_h
             with obs.span("stream_scatter", seg=seg.index, shard=i) as sp:
@@ -545,6 +702,10 @@ class StreamTrainer(BaseTrainer):
         self._xfer_bytes = 0
         self._scatter_s = 0.0
         self._scatter_wait_s = 0.0
+        self._spill_read_s = 0.0
+        self._spill_write_s = 0.0
+        self._spill_read_bytes = 0
+        self._spill_write_bytes = 0
         self._keys = [jax.random.fold_in(step_key, i) for i in range(P)]
         for c in self._cots.values():
             c[:] = 0.0
@@ -625,6 +786,18 @@ class StreamTrainer(BaseTrainer):
             "stream_scatter_overlap_frac": round(
                 min(max(scat_overlap, 0.0), 1.0), 4),
         }
+        if self._spill_dir:
+            # spill reads overlap via the ring (they run in _fetch on the
+            # worker); writes block the consumer, so the write fraction of
+            # wall time is the stall signal the watchdog tracks
+            self._last_stream_stats.update({
+                "stream_spill_read_s": round(self._spill_read_s, 6),
+                "stream_spill_write_s": round(self._spill_write_s, 6),
+                "stream_spill_bytes": int(self._spill_read_bytes
+                                          + self._spill_write_bytes),
+                "stream_spill_stall_frac": round(
+                    min(self._spill_write_s / wall, 1.0), 4),
+            })
         self._epoch_stream.append(
             dict(self._last_stream_stats, epoch=int(self.epoch)))
         led = obs.get_ledger()
@@ -634,6 +807,8 @@ class StreamTrainer(BaseTrainer):
             # prediction; wire bytes pair in driver._obs_epoch off the
             # metrics channel
             led.measure("overlap_frac", wk, st["overlap_frac"], "frac",
+                        epoch=int(self.epoch))
+            led.measure("stream_xfer_s", wk, st["transfer_s"], "s",
                         epoch=int(self.epoch))
         if self._metrics is not None and self._grad_acc is not None:
             from roc_tpu.obs import channel as obs_channel
@@ -659,6 +834,10 @@ class StreamTrainer(BaseTrainer):
                     slots=int(self.config.stream_slots),
                     num_parts=self._P, segments=self._nseg,
                     halo_width=self._K, slot_bytes=self.slot_bytes(),
+                    stream_dtype="bf16" if self._sdtype.itemsize == 2
+                    else "fp32",
+                    stream_spill=self._spill_dir,
+                    host_stores=stream_host.stats(),
                     epochs=list(self._epoch_stream))
 
     # -- eval / inference --------------------------------------------------
@@ -708,7 +887,8 @@ class StreamTrainer(BaseTrainer):
     def predict_logits(self):
         """Padded [P*S, C] logits (shard-major, same convention as the
         SPMD path; ``self._meta.unpad_nodes`` strips the padding)."""
-        self._logits_sink = np.zeros(
+        # d2h sink: filled from device_get results, never staged back
+        self._logits_sink = np.zeros(  # roclint: allow(unpinned-host-buffer) — device->host sink, never ships
             (self._P * self._S, self.dataset.num_classes), np.float32)
         try:
             self.evaluate()

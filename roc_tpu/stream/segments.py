@@ -24,7 +24,8 @@ from roc_tpu.models.model import Model, OpNode
 from roc_tpu.memory.estimator import _op_out_dims
 from roc_tpu import ops
 
-__all__ = ["Segment", "split_segments", "run_segment"]
+__all__ = ["Segment", "split_segments", "run_segment",
+           "predicted_epoch_bytes"]
 
 _HEAD_KINDS = ("aggregate", "gat")
 
@@ -105,6 +106,45 @@ def split_segments(model: Model) -> List[Segment]:
             out_dims={t: dims[t] for t in touched},
         ))
     return segs
+
+
+def predicted_epoch_bytes(segments: List[Segment], parts: int,
+                          shard_nodes: int, shard_edges: int, halo_k: int,
+                          num_classes: int, *, act_itemsize: int = 4,
+                          esrc_itemsize: int = 4,
+                          edst_itemsize: int = 4) -> int:
+    """Analytic bytes the executor's ``_fetch`` ships in one training
+    epoch: the sweep schedule ((nseg-1) fwd + nseg bwd), each sweep
+    rotating all ``parts`` shards, priced from the same store shapes
+    ``_fetch`` slices.  ``act_itemsize`` is the streamed storage dtype's
+    width (2 under -bf16-storage) and covers every float wire — tables,
+    own rows, labels, and the cotangent fetch, which the executor casts
+    to the storage dtype before shipping; in-degrees stay fp32 and the
+    mask int32.  Edge-index widths are passed separately because the
+    bf16 layout also narrows them to uint16 when the table fits.  PRNG
+    keys (a few device words per fetch) are not counted.  The kernel
+    budget gate (tools/check_kernel_budgets.py, ``check_stream_claim``)
+    prices both dtypes through this one function, so the committed
+    ratio and the runtime's ledger prediction can never drift apart."""
+    n = len(segments)
+    P, S, E, K = int(parts), int(shard_nodes), int(shard_edges), int(halo_k)
+    sweeps = [("fwd", k) for k in range(n - 1)] + \
+             [("bwd", k) for k in range(n - 1, -1, -1)]
+    total = 0
+    for phase, k in sweeps:
+        seg = segments[k]
+        b = E * (esrc_itemsize + edst_itemsize) + S * 4  # edges + indeg f32
+        if seg.head is not None:
+            b += (S + P * K) * seg.out_dims[seg.table_tid] * act_itemsize
+        for t in seg.own_in_tids:
+            b += S * seg.out_dims[t] * act_itemsize
+        if seg.is_last:
+            b += S * (num_classes * act_itemsize + 4)  # labels + mask i32
+        if phase == "bwd" and not seg.is_last:
+            for t in seg.out_tids:
+                b += S * seg.out_dims[t] * act_itemsize
+        total += b * P
+    return int(total)
 
 
 def run_segment(seg: Segment, params, table, own, esrc, edst, indeg, key,

@@ -1,0 +1,117 @@
+"""NVMe spill tier: memory-mapped boundary stores for -stream-spill.
+
+The third rung of the rotation ladder (HBM slot -> host store -> disk):
+segment-boundary activation and cotangent stores move from host RAM to
+``np.memmap`` files under the ``-stream-spill`` directory, so host
+memory only has to hold the graph-shaped arrays (features, labels,
+edges) while the per-segment boundary tensors — the part that scales
+with model depth times P*S — page through the OS cache from NVMe.  The
+PrefetchRing's worker reads slot i+1's rows off the map behind slot i's
+compute exactly like device staging, which is what keeps the tier
+composable with the existing overlap machinery.
+
+Each store file carries a 64-byte CRC'd header (the same hardening the
+.lux loader and the serve delta journal use: magic, version, dtype,
+shape, CRC32 over all of it) written via ``fault.fsync_replace`` so a
+crash can never leave an undetected torn header; the data region is
+extended sparsely after the promote.  A bad magic/version/CRC/short
+file raises :class:`SpillHeaderError` — typed, so callers distinguish
+"corrupt spill state" from transient I/O (which the ring already
+retries).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import ml_dtypes  # registers bfloat16 with numpy (jax dependency)
+import numpy as np
+
+__all__ = ["SpillError", "SpillHeaderError", "create_store", "open_store",
+           "HEADER_BYTES"]
+
+_MAGIC = b"RSPL"
+_VERSION = 1
+HEADER_BYTES = 64
+# magic | u16 version | u16 dtype-code | u64 rows | u64 cols | u32 crc32
+_HDR = struct.Struct("<4sHHQQI")
+
+# dtype codes are part of the on-disk format: append-only.
+_DTYPES = {1: np.dtype(np.float32), 2: np.dtype(ml_dtypes.bfloat16)}
+_CODES = {v: k for k, v in _DTYPES.items()}
+
+
+class SpillError(RuntimeError):
+    """A spill store that cannot be used (I/O layout, unknown dtype)."""
+
+
+class SpillHeaderError(SpillError):
+    """A spill store that cannot be *trusted*: torn/corrupt header."""
+
+
+def _pack_header(dtype: np.dtype, rows: int, cols: int) -> bytes:
+    code = _CODES.get(np.dtype(dtype))
+    if code is None:
+        raise SpillError(f"spill store: unsupported dtype {dtype!r}")
+    body = _HDR.pack(_MAGIC, _VERSION, code, rows, cols, 0)[:-4]
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    hdr = body + struct.pack("<I", crc)
+    return hdr + b"\0" * (HEADER_BYTES - len(hdr))
+
+
+def create_store(path: str, shape, dtype) -> np.ndarray:
+    """Create a zero-filled spill store at ``path`` and return its
+    writable memmap.  The CRC'd header is promoted durably
+    (tmp + fsync + rename, ``fault.fsync_replace``) before the data
+    region is extended, so every visible file has a valid header."""
+    from roc_tpu import fault
+
+    rows, cols = int(shape[0]), int(shape[1])
+    dtype = np.dtype(dtype)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_pack_header(dtype, rows, cols))
+    fault.fsync_replace(tmp, path)
+    nbytes = rows * cols * dtype.itemsize
+    with open(path, "r+b") as f:
+        f.truncate(HEADER_BYTES + nbytes)  # sparse: zero pages on demand
+    return np.memmap(path, dtype=dtype, mode="r+",
+                     offset=HEADER_BYTES, shape=(rows, cols))
+
+
+def open_store(path: str) -> np.ndarray:
+    """Open an existing spill store, validating the header end to end."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            raw = f.read(HEADER_BYTES)
+    except OSError as e:
+        raise SpillError(f"spill store {path!r}: {e}") from e
+    if len(raw) < HEADER_BYTES:
+        raise SpillHeaderError(
+            f"spill store {path!r}: truncated header "
+            f"({len(raw)} < {HEADER_BYTES} bytes)")
+    magic, version, code, rows, cols, crc = _HDR.unpack(raw[:_HDR.size])
+    if magic != _MAGIC:
+        raise SpillHeaderError(
+            f"spill store {path!r}: bad magic {magic!r} (not a spill store)")
+    if zlib.crc32(raw[:_HDR.size - 4]) & 0xFFFFFFFF != crc:
+        raise SpillHeaderError(
+            f"spill store {path!r}: header CRC mismatch — torn or corrupt "
+            "write; delete the spill directory and rerun")
+    if version != _VERSION:
+        raise SpillHeaderError(
+            f"spill store {path!r}: version {version} (expected {_VERSION})")
+    dtype = _DTYPES.get(code)
+    if dtype is None:
+        raise SpillHeaderError(
+            f"spill store {path!r}: unknown dtype code {code}")
+    want = HEADER_BYTES + rows * cols * dtype.itemsize
+    if size < want:
+        raise SpillHeaderError(
+            f"spill store {path!r}: data region truncated "
+            f"({size} < {want} bytes)")
+    return np.memmap(path, dtype=dtype, mode="r+",
+                     offset=HEADER_BYTES, shape=(rows, cols))

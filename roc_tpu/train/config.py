@@ -202,6 +202,12 @@ class Config:
                                   # suffixes).  Without -stream, a graph
                                   # whose resident bytes exceed it refuses
                                   # to run in-core — the out-of-core gate
+    stream_spill: str = ""        # spill directory for the third rotation
+                                  # tier: segment-boundary activation and
+                                  # cotangent stores memory-map to CRC'd
+                                  # files here (NVMe-class path) instead of
+                                  # host RAM, so host memory only holds the
+                                  # graph-shaped arrays.  Requires -stream
     serve_batch: int = 64         # serving microbatch cap (roc_tpu/serve):
                                   # a queue window drains when this many
                                   # queries accumulate, and the padded
@@ -260,10 +266,15 @@ class Config:
         if env.get("ROC_STREAM_BUDGET"):
             self.stream_budget = env["ROC_STREAM_BUDGET"]
         parse_size(self.stream_budget)  # validate eagerly
+        if env.get("ROC_STREAM_SPILL"):
+            self.stream_spill = env["ROC_STREAM_SPILL"]
         if self.stream_slots < 2:
             raise SystemExit(f"stream_slots={self.stream_slots}: the "
                              "prefetch ring needs >= 2 slots (double "
                              "buffering is the point)")
+        if self.stream_spill and not self.stream:
+            raise SystemExit("error: -stream-spill is a tier of the "
+                             "streaming executor; it requires -stream")
         # ROC_BF16_* mirror -bf16-storage/-bf16-rounding/-bf16-exchange for
         # driverless entry points (bench.py, hw_revalidate A/B loops).
         if env.get("ROC_BF16_STORAGE"):
@@ -473,6 +484,10 @@ def parse_args(argv: List[str]) -> Config:
     p.add_argument("-stream-budget", dest="stream_budget", default="",
                    help="aggregate device-memory budget the in-core path "
                         "is held to (e.g. 8g); larger graphs must -stream")
+    p.add_argument("-stream-spill", dest="stream_spill", default="",
+                   help="spill directory for boundary stores: the third "
+                        "rotation tier (NVMe memmap) when even host "
+                        "memory cannot hold the boundary activations")
     p.add_argument("-serve-batch", dest="serve_batch", type=int, default=64,
                    help="serving microbatch cap: window drains at this "
                         "many queries; bucket ladder tops out here")

@@ -646,6 +646,17 @@ class BaseTrainer:
                             f"fraction {alert['stall_frac']:.3f} is "
                             f"{alert['ratio']:.2f}x its EWMA "
                             f"({alert['ewma']:.3f})")
+            if extra and "stream_spill_stall_frac" in extra:
+                alert = self.watchdog.observe_spill(
+                    epoch, extra["stream_spill_stall_frac"])
+                if alert is not None:
+                    self._metrics.emit("watchdog", **alert)
+                    if self.config.verbose:
+                        print_fn(
+                            f"# watchdog: epoch {epoch} spill stall "
+                            f"fraction {alert['stall_frac']:.3f} is "
+                            f"{alert['ratio']:.2f}x its EWMA "
+                            f"({alert['ewma']:.3f})")
             # Calibration drift: the pairs joined this epoch feed the
             # per-model ratio EWMAs.  Off the TPU backends only the
             # structurally-exact models are judged — the time models'
@@ -712,7 +723,8 @@ class BaseTrainer:
             budget = memory.device_budget_bytes()
         self.mem_plan = memory.plan_memory(
             self.mem_estimate, mode=cfg.mem_plan, budget_bytes=budget,
-            offload_executed=getattr(cfg, "stream", False))
+            offload_executed=getattr(cfg, "stream", False),
+            offload_spills=bool(getattr(cfg, "stream_spill", "")))
         # Ledger predictions made once, before the first epoch: the
         # estimator's all-KEEP step time and the memory plan's peak —
         # paired per epoch in _obs_epoch (wall clock / device-reported
@@ -1176,7 +1188,10 @@ def make_trainer(config: Config, dataset: Dataset, model: Model) -> BaseTrainer:
             raise SystemExit(
                 f"error: graph needs ~{_fmt(need)} device-resident "
                 f"but -stream-budget is {_fmt(budget)}; rerun "
-                f"with -stream to rotate shards through host memory")
+                f"with -stream to rotate shards through host memory "
+                f"(add -stream-spill DIR when even host memory cannot "
+                f"hold the boundary stores, and -bf16-storage to halve "
+                f"the streamed bytes)")
     if config.num_parts > 1:
         from roc_tpu.parallel.spmd import SpmdTrainer
         return SpmdTrainer(config, dataset, model)

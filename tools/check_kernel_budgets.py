@@ -52,6 +52,18 @@ the round's acceptance claim: the region's per-layer share of predicted
 train-step HBM at the Reddit GCN shape must be <= 0.5x PR 10's per-layer
 mega+bwd number (the >= 2x cut of record, docs/PERF.md round 16).
 
+Stream rows (round 20): the Reddit-scale shape carries a ``stream``
+entry — predicted streamed wire bytes/epoch for the out-of-core
+executor at the GCN-of-record layers, priced both ways by
+stream.segments.predicted_epoch_bytes on the real partition + frozen
+halo width K.  check_stream_claim gates the round's acceptance claim:
+the bf16 tier (2-byte slot activations + compact uint16 edge wire where
+the frozen table space fits 16 bits) must move <= 0.55x the fp32
+streamed baseline's bytes/epoch.  The ratio is not a clean 0.5 because
+indegree/mask wire and the int32-vs-uint16 edge split are dtype-mixed;
+0.55 holds only while BOTH cuts (bf16 floats and the compact edge wire)
+stay live.
+
     python tools/check_kernel_budgets.py            # diff, exit 1 on drift
     python tools/check_kernel_budgets.py --update   # regenerate the table
 """
@@ -126,6 +138,18 @@ GAT_MAX_RATIO = 0.6
 # paper's K=8, F'=8 and Reddit's K=2, F=64 both pad to the same tile).
 GAT_K, GAT_F = 2, 64
 
+# Max allowed bf16-streamed / fp32-streamed predicted bytes-per-epoch
+# ratio at the Reddit-scale shape (round-20 acceptance: the bf16 slot
+# tier plus the compact uint16 edge wire must nearly halve the streamed
+# bill; the dtype-independent indegree/mask wire keeps it above 0.5).
+STREAM_BF16_MAX_RATIO = 0.55
+
+# Streamed-row pricing configuration: the GCN of record (Reddit's
+# 602-256-41 stack) rotated through 8 parts — the shape docs/PERF.md
+# round 20 reports.
+STREAM_PARTS = 8
+STREAM_LAYERS = [602, 256, 41]
+
 
 def _geometries():
     import roc_tpu.ops.pallas.binned as B
@@ -165,8 +189,70 @@ def compute_table():
         entry["megakernel_bwd"] = _mega_bwd_entry(src, dst, n, e)
         entry["megakernel_xlayer"] = _xlayer_entry(src, dst, n, e)
         entry["gat_fused"] = _gat_entry(src, dst, n, e)
+        if name == "reddit_scaled":
+            # the stream row needs a real partition + halo maps (O(E)
+            # with a per-part unique) — priced once, at the shape the
+            # acceptance claim is stated at
+            entry["stream"] = _stream_entry(src, dst, n, e)
         table[name] = entry
     return table
+
+
+def _stream_entry(src, dst, n, e):
+    """Streamed-epoch wire row (round 20, stream/segments.py).  Prices
+    predicted streamed bytes/epoch for the out-of-core executor at the
+    GCN of record, both dtype tiers, on the REAL partition geometry:
+    partition_graph's padded S/E and _stream_maps' frozen halo width K
+    — the same numbers the executor's ledger predicts from.  The bf16
+    leg applies the executor's own compact-edge eligibility rule
+    (uint16 esrc when S + P*K fits 16 bits, uint16 edst when S does)."""
+    from roc_tpu.graph.csr import from_edges
+    from roc_tpu.graph.partition import partition_graph
+    from roc_tpu.models import build_gcn
+    from roc_tpu.stream.executor import _stream_maps
+    from roc_tpu.stream.segments import predicted_epoch_bytes, split_segments
+
+    part = partition_graph(from_edges(n, src, dst), STREAM_PARTS)
+    K, _, _ = _stream_maps(part.meta, part.edge_src)
+    segs = split_segments(build_gcn(STREAM_LAYERS, 0.0))
+    P, S, E = STREAM_PARTS, part.shard_nodes, part.shard_edges
+    fp32 = predicted_epoch_bytes(segs, P, S, E, K, STREAM_LAYERS[-1])
+    esrc_sz = 2 if S + P * K <= 1 << 16 else 4
+    edst_sz = 2 if S <= 1 << 16 else 4
+    bf16 = predicted_epoch_bytes(segs, P, S, E, K, STREAM_LAYERS[-1],
+                                 act_itemsize=2, esrc_itemsize=esrc_sz,
+                                 edst_itemsize=edst_sz)
+    return {
+        "parts": STREAM_PARTS, "layers": list(STREAM_LAYERS),
+        "shard_nodes": int(S), "shard_edges": int(E), "halo_k": int(K),
+        "epoch_bytes_fp32": int(fp32),
+        "epoch_bytes_bf16": int(bf16),
+        "esrc_itemsize_bf16": esrc_sz,
+        "edst_itemsize_bf16": edst_sz,
+    }
+
+
+def check_stream_claim(table):
+    """Round-20 acceptance gate: the bf16 streamed tier must keep
+    predicted streamed bytes/epoch <= STREAM_BF16_MAX_RATIO x the fp32
+    streamed baseline at the Reddit shape, and the compact uint16 edge
+    wire must stay eligible there — losing eligibility (frozen table
+    space outgrowing 16 bits) silently hands the edge arrays their full
+    int32 width back and the ratio decays toward 0.58."""
+    problems = []
+    r = table["reddit_scaled"]["stream"]
+    b16, b32 = r["epoch_bytes_bf16"], r["epoch_bytes_fp32"]
+    if b16 > STREAM_BF16_MAX_RATIO * b32:
+        problems.append(
+            f"stream bf16 claim: predicted streamed {b16} bytes/epoch > "
+            f"{STREAM_BF16_MAX_RATIO}x fp32 streamed {b32} at "
+            f"reddit_scaled — ratio {b16 / b32:.3f}")
+    if r["esrc_itemsize_bf16"] != 2 or r["edst_itemsize_bf16"] != 2:
+        problems.append(
+            "stream bf16 claim: compact uint16 edge wire no longer "
+            "eligible at reddit_scaled — the bf16 tier is paying int32 "
+            "edge bytes")
+    return problems
 
 
 def _gat_entry(src, dst, n, e):
@@ -479,7 +565,7 @@ def main(argv=None) -> int:
     table = compute_table()
     problems = (check_flat_claim(table) + check_mega_claim(table)
                 + check_mega_bwd_claim(table) + check_xlayer_claim(table)
-                + check_gat_claim(table))
+                + check_gat_claim(table) + check_stream_claim(table))
     if update:
         if problems:
             for p in problems:

@@ -246,6 +246,21 @@ ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
     | tail -2 | tee -a "$LOG"
 ROC_BENCH_STREAM=1 ROC_STREAM_SLOTS=2 ROC_BENCH_EPOCHS=5 \
     timeout 1800 python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+note "   round-20 tier legs: bf16-streamed (wire bytes must land near"
+note "   0.5x the fp32 streamed leg's stream.bytes_per_epoch — the"
+note "   kernel_budgets stream row's <= 0.55x claim, measured), then the"
+note "   NVMe spill tier (same slots; record stream.stream_spill_stall_frac"
+note "   — the cost model predicts near-zero when spill reads hide under"
+note "   the ring like host reads do).  Artifacts stamp stream_dtype/"
+note "   stream_spill, so the paired legs stay distinguishable."
+ROC_BENCH_STREAM=1 ROC_STREAM_SLOTS=2 ROC_BF16_STORAGE=1 \
+    ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
+SPILL_DIR=$(mktemp -d /tmp/roc_spill.XXXXXX)
+ROC_BENCH_STREAM=1 ROC_STREAM_SLOTS=2 ROC_STREAM_SPILL="$SPILL_DIR" \
+    ROC_BENCH_EPOCHS=5 timeout 1800 python bench.py 2>&1 \
+    | tail -2 | tee -a "$LOG"
+rm -rf "$SPILL_DIR"
 # driver-path smoke on real hardware: >2x-budget rotation + live obs
 timeout 900 python -m roc_tpu -dataset reddit-small -layers 602-128-41 \
     -e 10 -parts 4 -stream -stream-slots 2 -v 2>&1 | tail -3 | tee -a "$LOG"
